@@ -1,0 +1,34 @@
+"""inspektor_gadget_tpu — a TPU-native streaming-analytics framework.
+
+Re-designed from scratch with the capability surface of Inspektor Gadget
+(reference at /root/reference: a Kubernetes-native eBPF observability
+framework). Where the reference runs eBPF programs per node and merges JSON
+streams client-side, this framework batches events into struct-of-arrays
+tensors and maintains mergeable sketches (count-min / HyperLogLog / entropy /
+autoencoder anomaly scores) in JAX, merged cluster-wide with jax.lax.psum over
+a device mesh.
+
+Layer map (mirrors reference SURVEY §1, re-architected TPU-first):
+
+  sources/    event capture: C++ capture shims + ring buffer bridge, synthetic
+              replay generators          (ref: pkg/gadgets/*/tracer/bpf/*.bpf.c)
+  columns/    typed column system, filters, sort, formatter, tensorization
+                                          (ref: pkg/columns, pkg/parser)
+  params/     self-describing param/flag system (ref: pkg/params)
+  gadgets/    gadget descriptors + capability protocols + registry
+                                          (ref: pkg/gadgets, pkg/gadget-registry)
+  operators/  pluggable enrichment pipeline with dependency sort
+                                          (ref: pkg/operators)
+  containers/ container collection, selectors, pubsub, tracer collection
+                                          (ref: pkg/container-collection)
+  runtime/    local + distributed (gRPC fan-out) runtimes (ref: pkg/runtime)
+  agent/      per-node agent service      (ref: pkg/gadgettracermanager,
+                                           pkg/gadget-service)
+  ops/        JAX/Pallas sketch kernels: count-min, HLL, entropy, top-k
+  models/     autoencoder anomaly scorer (advise-style analytics)
+  parallel/   meshes, shardings, psum sketch merges, distributed init
+  cli/        auto-generated CLI from the gadget registry (ref: cmd/)
+  native/     C++ sources for capture shims and the ring-buffer bridge
+"""
+
+__version__ = "0.1.0"

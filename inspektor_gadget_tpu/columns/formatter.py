@@ -1,0 +1,77 @@
+"""Text-column formatter (ref: pkg/columns/formatter/textcolumns, ~714 LoC).
+
+Produces aligned, width-constrained tables with header rows, per-column
+ellipsis, and auto-scaling of column widths to the terminal width — the
+behavioral contract of the reference's textcolumns formatter (widths from
+column metadata, auto-scale in textcolumns.go AdjustWidthsToScreen).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+from .columns import Column, Columns
+from .ellipsis import truncate
+
+
+class TextFormatter:
+    def __init__(
+        self,
+        columns: Columns,
+        *,
+        show_columns: list[str] | None = None,
+        max_width: int | None = None,
+        divider: str = " ",
+        header_style: str = "upper",
+    ):
+        self.columns = columns
+        if show_columns is not None:
+            columns.set_visible(show_columns)
+        self.divider = divider
+        self.header_style = header_style
+        self._widths: dict[str, int] = {}
+        for c in columns.visible():
+            self._widths[c.name] = max(c.width, len(c.name))
+        if max_width:
+            self.adjust_widths(max_width)
+
+    def adjust_widths(self, max_width: int) -> None:
+        """Scale non-fixed columns proportionally to fit max_width
+        (ref: textcolumns AdjustWidthsToScreen)."""
+        cols = self.columns.visible()
+        total = sum(self._widths[c.name] for c in cols) + len(self.divider) * (len(cols) - 1)
+        if total <= max_width:
+            return
+        fixed = sum(self._widths[c.name] for c in cols if c.fixed)
+        flexible = total - fixed - len(self.divider) * (len(cols) - 1)
+        budget = max_width - fixed - len(self.divider) * (len(cols) - 1)
+        if budget <= 0 or flexible <= 0:
+            return
+        scale = budget / flexible
+        for c in cols:
+            if not c.fixed:
+                self._widths[c.name] = max(c.min_width, int(self._widths[c.name] * scale))
+
+    def _cell(self, c: Column, text: str) -> str:
+        w = self._widths[c.name]
+        text = truncate(text, w, c.ellipsis)
+        return text.rjust(w) if c.align == "right" else text.ljust(w)
+
+    def header(self) -> str:
+        cells = []
+        for c in self.columns.visible():
+            name = c.name.upper() if self.header_style == "upper" else c.name
+            cells.append(self._cell(c, name))
+        return self.divider.join(cells).rstrip()
+
+    def format_event(self, event: Any) -> str:
+        cells = [
+            self._cell(c, c.format_value(c.value(event)))
+            for c in self.columns.visible()
+        ]
+        return self.divider.join(cells).rstrip()
+
+    def format_table(self, events: Iterable[Any]) -> str:
+        lines = [self.header()]
+        lines.extend(self.format_event(e) for e in events)
+        return "\n".join(lines)

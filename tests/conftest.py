@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU platform before JAX init.
+
+Mirrors the reference's test strategy of kernel-real-but-container-free unit
+tests (reference internal/test/runner.go:103-218 unshares namespaces to fake
+containers); here the analogue is a virtual 8-device CPU mesh standing in for
+a TPU pod slice so sharding/psum paths are exercised without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

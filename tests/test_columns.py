@@ -1,0 +1,175 @@
+"""Columns system tests (coverage model: pkg/columns/columns_test.go, 448 LoC)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from inspektor_gadget_tpu.columns import (
+    Columns,
+    col,
+    parse_filters,
+    match_event,
+    columnar_mask,
+    parse_sort,
+    sort_events,
+    columnar_argsort,
+    group_events,
+    TextFormatter,
+    truncate,
+)
+from inspektor_gadget_tpu.columns.columns import fnv1a64
+
+
+@dataclasses.dataclass
+class Ev:
+    comm: str = col("", width=16)
+    pid: int = col(0, width=7, dtype=np.int32)
+    reads: int = col(0, width=10, group="sum", dtype=np.int64)
+    lat: float = col(0.0, width=8, precision=3, dtype=np.float32)
+    hidden: int = col(0, hide=True, dtype=np.int32)
+
+
+@pytest.fixture
+def cols():
+    return Columns(Ev)
+
+
+def events():
+    return [
+        Ev("bash", 10, 5, 1.5),
+        Ev("curl", 20, 3, 0.25),
+        Ev("bash", 30, 7, 2.0),
+        Ev("python", 5, 1, 9.125),
+    ]
+
+
+def test_registry_names_and_visibility(cols):
+    assert cols.names() == ["comm", "pid", "reads", "lat"]
+    assert cols.names(visible_only=False) == ["comm", "pid", "reads", "lat", "hidden"]
+    assert cols.get("PID").dtype == np.dtype(np.int32)
+    assert cols.get("comm").is_string
+
+
+def test_set_visible_reorders(cols):
+    cols.set_visible(["pid", "comm"])
+    assert cols.names() == ["pid", "comm"]
+
+
+def test_duplicate_column_rejected():
+    @dataclasses.dataclass
+    class Dup:
+        a: int = col(0, name="x")
+        b: int = col(0, name="x")
+
+    with pytest.raises(ValueError, match="duplicate"):
+        Columns(Dup)
+
+
+def test_to_dict_json_roundtrip(cols):
+    ev = Ev("bash", 10, 5, 1.5)
+    d = cols.to_dict(ev)
+    assert d["comm"] == "bash" and d["pid"] == 10
+    back = cols.from_dict(d)
+    assert back == ev
+
+
+# -- filters (ref: pkg/columns/filter/filter_test.go) -----------------------
+
+def test_filter_exact_and_negated(cols):
+    fs = parse_filters("comm:bash", cols)
+    got = [e for e in events() if match_event(e, fs, cols)]
+    assert len(got) == 2
+    fs = parse_filters("comm:!bash", cols)
+    got = [e for e in events() if match_event(e, fs, cols)]
+    assert {e.comm for e in got} == {"curl", "python"}
+
+
+def test_filter_numeric_comparisons(cols):
+    fs = parse_filters("pid:>=20", cols)
+    got = [e for e in events() if match_event(e, fs, cols)]
+    assert {e.pid for e in got} == {20, 30}
+    fs = parse_filters("lat:<1", cols)
+    got = [e for e in events() if match_event(e, fs, cols)]
+    assert [e.comm for e in got] == ["curl"]
+
+
+def test_filter_regex_and_multi(cols):
+    fs = parse_filters("comm:~^py,pid:<10", cols)
+    got = [e for e in events() if match_event(e, fs, cols)]
+    assert [e.comm for e in got] == ["python"]
+
+
+def test_filter_unknown_column(cols):
+    with pytest.raises(ValueError, match="unknown column"):
+        parse_filters("nope:1", cols)
+
+
+def test_columnar_mask_matches_rowwise(cols):
+    vocab: dict[int, str] = {}
+    batch = cols.tensorize(events(), vocab)
+    fs = parse_filters("comm:bash,reads:>5", cols)
+    mask = columnar_mask(batch, fs, cols, vocab)
+    row = [match_event(e, fs, cols) for e in events()]
+    assert mask.tolist() == row
+
+
+# -- sort (ref: pkg/columns/sort/sort_test.go) ------------------------------
+
+def test_sort_multi_key(cols):
+    specs = parse_sort("comm,-pid", cols)
+    out = sort_events(events(), specs, cols)
+    assert [(e.comm, e.pid) for e in out] == [
+        ("bash", 30), ("bash", 10), ("curl", 20), ("python", 5),
+    ]
+
+
+def test_columnar_argsort_matches(cols):
+    batch = cols.tensorize(events())
+    specs = parse_sort("-reads", cols)
+    idx = columnar_argsort(batch, specs, cols)
+    assert batch["reads"][idx].tolist() == [7, 5, 3, 1]
+
+
+# -- group (ref: pkg/columns/group/group_test.go) ---------------------------
+
+def test_group_by_sums_annotated(cols):
+    out = group_events(events(), ["comm"], cols)
+    by = {e.comm: e.reads for e in out}
+    assert by == {"bash": 12, "curl": 3, "python": 1}
+
+
+# -- tensorize --------------------------------------------------------------
+
+def test_tensorize_dtypes_and_hash(cols):
+    vocab: dict[int, str] = {}
+    batch = cols.tensorize(events(), vocab)
+    assert batch["pid"].dtype == np.int32
+    assert batch["comm"].dtype == np.uint64
+    assert vocab[int(batch["comm"][0])] == "bash"
+    assert batch["comm"][0] == np.uint64(fnv1a64("bash"))
+    assert batch["comm"][0] == batch["comm"][2]  # same string, same hash
+
+
+# -- formatter (ref: formatter/textcolumns tests) ---------------------------
+
+def test_formatter_header_and_rows(cols):
+    f = TextFormatter(cols)
+    h = f.header()
+    assert h.startswith("COMM")
+    row = f.format_event(Ev("bash", 10, 5, 1.5))
+    assert "bash" in row and "1.500" in row
+    assert "hidden" not in h.lower()
+
+
+def test_formatter_width_scaling(cols):
+    f = TextFormatter(cols, max_width=25)
+    assert all(len(line) <= 25 for line in f.format_table(events()).splitlines())
+
+
+def test_truncate_modes():
+    assert truncate("abcdefgh", 5, "end") == "abcd…"
+    assert truncate("abcdefgh", 5, "start") == "…efgh"
+    assert truncate("abcdefgh", 5, "middle") == "ab…gh"
+    assert truncate("abc", 5, "end") == "abc"
+    assert truncate("abcdefgh", 5, "none") == "abcde"

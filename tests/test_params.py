@@ -1,0 +1,113 @@
+"""Params system tests (coverage model: pkg/params/*_test.go)."""
+
+import pytest
+
+from inspektor_gadget_tpu.params import (
+    Collection,
+    ParamDesc,
+    ParamDescs,
+    ParamError,
+    TypeHint,
+    parse_duration,
+    validate_int_range,
+    validate_one_of,
+)
+from inspektor_gadget_tpu.params.params import descs_from_json
+
+
+def make_descs():
+    return ParamDescs([
+        ParamDesc(key="timeout", default="0", type_hint=TypeHint.DURATION),
+        ParamDesc(key="max-rows", default="20", type_hint=TypeHint.INT,
+                  validator=validate_int_range(1, 100)),
+        ParamDesc(key="sort", default="-reads"),
+        ParamDesc(key="host", default="false", type_hint=TypeHint.BOOL),
+        ParamDesc(key="mode", default="all", possible_values=("all", "new")),
+    ])
+
+
+def test_defaults_and_typed_getters():
+    p = make_descs().to_params()
+    assert p.get("max-rows").as_int() == 20
+    assert p.get("host").as_bool() is False
+    assert p.get("sort").as_string() == "-reads"
+    assert p.get("timeout").as_duration() == 0.0
+
+
+def test_set_validates():
+    p = make_descs().to_params()
+    p.set("max-rows", "50")
+    assert p.get("max-rows").as_int() == 50
+    with pytest.raises(ParamError):
+        p.set("max-rows", "500")
+    with pytest.raises(ParamError):
+        p.set("mode", "bogus")
+    with pytest.raises(ParamError):
+        p.set("host", "maybe")
+
+
+def test_set_non_string_coerced():
+    p = make_descs().to_params()
+    p.set("host", True)
+    assert p.get("host").as_bool() is True
+    p.set("max-rows", 3)
+    assert p.get("max-rows").as_int() == 3
+
+
+def test_duration_parsing():
+    assert parse_duration("1m30s") == 90.0
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration("15") == 15.0
+    with pytest.raises(ValueError):
+        parse_duration("abc")
+
+
+def test_copy_map_roundtrip_with_prefix():
+    p = make_descs().to_params()
+    p.set("sort", "comm")
+    wire = p.copy_to_map(prefix="gadget.")
+    assert wire["gadget.sort"] == "comm"
+    q = make_descs().to_params()
+    q.copy_from_map(wire, prefix="gadget.")
+    assert q.get("sort").as_string() == "comm"
+
+
+def test_collection_prefixes():
+    coll = Collection({
+        "gadget.": make_descs().to_params(),
+        "operator.sketch.": ParamDescs([
+            ParamDesc(key="width", default="2048", type_hint=TypeHint.INT),
+        ]).to_params(),
+    })
+    wire = {"gadget.max-rows": "5", "operator.sketch.width": "4096", "junk": "x"}
+    coll.copy_from_map(wire)
+    assert coll["gadget."].get("max-rows").as_int() == 5
+    assert coll["operator.sketch."].get("width").as_int() == 4096
+    out = coll.copy_to_map()
+    assert out["operator.sketch.width"] == "4096"
+
+
+def test_catalog_json_roundtrip():
+    p = make_descs().to_params()
+    j = p.to_descs_json()
+    descs = descs_from_json(j)
+    q = descs.to_params()
+    assert q.get("mode").desc.possible_values == ("all", "new")
+    assert q.get("max-rows").as_int() == 20
+
+
+def test_mandatory_param():
+    descs = ParamDescs([ParamDesc(key="name", is_mandatory=True)])
+    p = descs.to_params()
+    with pytest.raises(ParamError):
+        p.validate()
+    p.set("name", "x")
+    p.validate()
+
+
+def test_validate_one_of():
+    v = validate_one_of(["a", "b"])
+    v("a")
+    with pytest.raises(ValueError):
+        v("c")
