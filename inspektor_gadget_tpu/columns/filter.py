@@ -103,11 +103,20 @@ def columnar_mask(
                 )
             else:
                 raise ValueError(f"op {f.op!r} unsupported on string column")
+        elif f.op == "re":
+            # regex on a numeric column: match the stringified values, same
+            # as the row-wise path
+            m = np.asarray([bool(f._regex.search(str(v))) for v in arr])
         else:
             try:
                 val = np.asarray(f.value).astype(arr.dtype)
             except ValueError:
-                m = np.zeros(n, dtype=bool)
+                # unparseable comparison value: row path compares str(v) for
+                # eq and returns False for ordered ops — mirror that
+                if f.op == "eq":
+                    m = np.asarray([str(v) == f.value for v in arr])
+                else:
+                    m = np.zeros(n, dtype=bool)
                 mask &= ~m if f.negate else m
                 continue
             m = {
@@ -116,6 +125,6 @@ def columnar_mask(
                 "ge": arr >= val,
                 "lt": arr < val,
                 "le": arr <= val,
-            }[f.op if f.op != "re" else "eq"]
+            }[f.op]
         mask &= ~m if f.negate else m
     return mask

@@ -1,0 +1,24 @@
+"""Analytics models: the autoencoder anomaly scorer and its online trainer.
+
+The reference's closest analogue is advise/seccomp-profile (record per-
+container syscall sets, synthesize a policy; pkg/gadgets/advise/seccomp +
+pkg/gadget-collection/gadgets/advise/seccomp/gadget.go). Here the per-
+container syscall *distribution* (from the entropy sketch's hashed count
+vector) feeds a small autoencoder; reconstruction error is the anomaly
+score, trained online with optax — batched bf16 matmuls on the MXU.
+"""
+
+from .autoencoder import (
+    AnomalyScorer,
+    AEConfig,
+    ae_init,
+    ae_apply,
+    ae_loss,
+    ae_train_step,
+    ae_score,
+)
+
+__all__ = [
+    "AnomalyScorer", "AEConfig", "ae_init", "ae_apply", "ae_loss",
+    "ae_train_step", "ae_score",
+]

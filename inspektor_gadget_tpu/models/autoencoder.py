@@ -1,0 +1,109 @@
+"""Autoencoder anomaly scorer over per-container event distributions.
+
+Input: L1-normalized, log-scaled count vectors (e.g. the 2^12-bucket syscall
+distribution from the entropy sketch, per container). A 3-layer MLP
+autoencoder reconstructs the vector; per-row MSE is the anomaly score.
+Online training: Adam on streaming mini-batches; weights replicate across
+the mesh, gradients psum over the 'node' axis (pure DP — the vectors are
+tiny; the matmuls batch onto the MXU in bf16).
+
+TPU notes: params kept in f32, activations cast to bf16 for the matmuls;
+hidden sizes padded to multiples of 128 (MXU lane width).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class AEConfig:
+    input_dim: int = 4096        # matches entropy sketch width (2^12)
+    hidden_dim: int = 512
+    latent_dim: int = 128
+    learning_rate: float = 1e-3
+    compute_dtype: Any = jnp.bfloat16
+
+
+@flax.struct.dataclass
+class AnomalyScorer:
+    params: dict
+    opt_state: Any
+    steps: jnp.ndarray
+    config: AEConfig = flax.struct.field(pytree_node=False)
+
+
+def _optimizer(cfg: AEConfig):
+    return optax.adam(cfg.learning_rate)
+
+
+def ae_init(cfg: AEConfig = AEConfig(), seed: int = 0) -> AnomalyScorer:
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 6)
+
+    def dense(key, fan_in, fan_out):
+        scale = jnp.sqrt(2.0 / fan_in)
+        return {
+            "w": jax.random.normal(key, (fan_in, fan_out), jnp.float32) * scale,
+            "b": jnp.zeros((fan_out,), jnp.float32),
+        }
+
+    params = {
+        "enc1": dense(ks[0], cfg.input_dim, cfg.hidden_dim),
+        "enc2": dense(ks[1], cfg.hidden_dim, cfg.latent_dim),
+        "dec1": dense(ks[2], cfg.latent_dim, cfg.hidden_dim),
+        "dec2": dense(ks[3], cfg.hidden_dim, cfg.input_dim),
+    }
+    opt_state = _optimizer(cfg).init(params)
+    return AnomalyScorer(params=params, opt_state=opt_state,
+                         steps=jnp.zeros((), jnp.int32), config=cfg)
+
+
+def _layer(x, p, dtype):
+    return x.astype(dtype) @ p["w"].astype(dtype) + p["b"].astype(dtype)
+
+
+def ae_apply(params: dict, x: jnp.ndarray, cfg: AEConfig) -> jnp.ndarray:
+    dt = cfg.compute_dtype
+    h = jax.nn.gelu(_layer(x, params["enc1"], dt))
+    z = jax.nn.gelu(_layer(h, params["enc2"], dt))
+    h = jax.nn.gelu(_layer(z, params["dec1"], dt))
+    out = _layer(h, params["dec2"], dt)
+    return out.astype(jnp.float32)
+
+
+def normalize_counts(counts: jnp.ndarray) -> jnp.ndarray:
+    """log1p + L1 normalize a (batch, dim) count matrix."""
+    x = jnp.log1p(counts.astype(jnp.float32))
+    return x / jnp.maximum(x.sum(axis=-1, keepdims=True), 1e-6)
+
+
+def ae_loss(params: dict, x: jnp.ndarray, cfg: AEConfig) -> jnp.ndarray:
+    recon = ae_apply(params, x, cfg)
+    return jnp.mean((recon - x) ** 2)
+
+
+def ae_score(scorer: AnomalyScorer, x: jnp.ndarray) -> jnp.ndarray:
+    """Per-row anomaly score: reconstruction MSE, scaled for display."""
+    recon = ae_apply(scorer.params, x, scorer.config)
+    return jnp.mean((recon - x) ** 2, axis=-1) * x.shape[-1]
+
+
+def ae_train_step(
+    scorer: AnomalyScorer, x: jnp.ndarray, axis_name: str | None = None
+) -> tuple[AnomalyScorer, jnp.ndarray]:
+    """One Adam step; grads psum'd over `axis_name` when run under shard_map
+    (data-parallel over the node axis of the mesh)."""
+    loss, grads = jax.value_and_grad(ae_loss)(scorer.params, x, scorer.config)
+    if axis_name is not None:
+        grads = jax.lax.pmean(grads, axis_name)
+        loss = jax.lax.pmean(loss, axis_name)
+    updates, opt_state = _optimizer(scorer.config).update(grads, scorer.opt_state, scorer.params)
+    params = optax.apply_updates(scorer.params, updates)
+    return scorer.replace(params=params, opt_state=opt_state, steps=scorer.steps + 1), loss

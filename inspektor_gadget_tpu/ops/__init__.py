@@ -1,0 +1,29 @@
+"""JAX/XLA/Pallas sketch kernels — the TPU analytics plane.
+
+The reference streams raw events end-to-end (perf ring → Go structs → JSON).
+Here, unbounded event streams fold into fixed-size **mergeable** summaries on
+device: count-min (heavy-hitter counts), HyperLogLog (distinct counts),
+entropy (distribution skew), and a candidate top-k table. Mergeability is the
+point: cluster-wide aggregation (the reference's snapshotcombiner +
+client-side JSON merge, pkg/snapshotcombiner, pkg/runtime/grpc) becomes one
+jax.lax.psum / element-wise max over a device mesh.
+
+All state lives in 32-bit arrays (TPU-native; JAX x64 stays off). 64-bit
+event keys from the column tensorizer are folded to uint32 on ingest.
+"""
+
+from .hashing import fold64_to_32, fmix32, multiply_shift
+from .countmin import CountMin, cms_init, cms_update, cms_query, cms_merge
+from .hll import HLL, hll_init, hll_update, hll_estimate, hll_merge
+from .entropy import EntropySketch, entropy_init, entropy_update, entropy_estimate, entropy_merge
+from .topk import TopK, topk_init, topk_update, topk_merge, topk_values
+from .sketches import SketchBundle, bundle_init, bundle_update, bundle_merge
+
+__all__ = [
+    "fold64_to_32", "fmix32", "multiply_shift",
+    "CountMin", "cms_init", "cms_update", "cms_query", "cms_merge",
+    "HLL", "hll_init", "hll_update", "hll_estimate", "hll_merge",
+    "EntropySketch", "entropy_init", "entropy_update", "entropy_estimate", "entropy_merge",
+    "TopK", "topk_init", "topk_update", "topk_merge", "topk_values",
+    "SketchBundle", "bundle_init", "bundle_update", "bundle_merge",
+]

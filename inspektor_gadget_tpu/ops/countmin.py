@@ -1,0 +1,82 @@
+"""Count-min sketch (heavy-hitter counting plane).
+
+Role in the framework: replaces the reference's exact per-key stat maps
+(e.g. top/file's BPF hash map drained per interval,
+pkg/gadgets/top/file/tracer/tracer.go:222-272) with a fixed-size mergeable
+summary: update is a scatter-add over `depth` hashed rows, query is the min
+over rows, merge is elementwise add — so cluster-wide aggregation is a psum.
+
+Guarantee: with width w and depth d, overestimate ≤ N·e/w with prob 1-e^-d.
+depth=4, width=65536 keeps heavy-hitter relative error well under the 1%
+BASELINE target at millions of events.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from .hashing import row_hashes
+
+
+@flax.struct.dataclass
+class CountMin:
+    table: jnp.ndarray  # (depth, width) int32
+    total: jnp.ndarray  # () int64-ish held as int32 pair? keep float32 count
+    log2_width: int = flax.struct.field(pytree_node=False)
+
+    @property
+    def depth(self) -> int:
+        return self.table.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.table.shape[1]
+
+
+def cms_init(depth: int = 4, log2_width: int = 16, dtype=jnp.int32) -> CountMin:
+    return CountMin(
+        table=jnp.zeros((depth, 1 << log2_width), dtype=dtype),
+        total=jnp.zeros((), dtype=jnp.float32),
+        log2_width=log2_width,
+    )
+
+
+def cms_update(state: CountMin, keys: jnp.ndarray, weights: jnp.ndarray | None = None) -> CountMin:
+    """Scatter-add a batch of uint32 keys. `weights` defaults to 1 per event;
+    masked/padded slots pass weight 0 (fixed batch shapes, no dynamic sizes)."""
+    if weights is None:
+        weights = jnp.ones(keys.shape, dtype=state.table.dtype)
+    weights = weights.astype(state.table.dtype)
+    idx = row_hashes(keys, state.depth, state.log2_width)  # (depth, n)
+    rows = jnp.broadcast_to(
+        jnp.arange(state.depth, dtype=jnp.int32)[:, None], idx.shape
+    )
+    table = state.table.at[rows.reshape(-1), idx.reshape(-1)].add(
+        jnp.tile(weights, (state.depth,))
+    )
+    return state.replace(table=table, total=state.total + weights.sum().astype(jnp.float32))
+
+
+def cms_query(state: CountMin, keys: jnp.ndarray) -> jnp.ndarray:
+    """Point estimate: min over depth rows (classic CM upper bound)."""
+    idx = row_hashes(keys, state.depth, state.log2_width)
+    gathered = jnp.stack(
+        [state.table[d, idx[d]] for d in range(state.depth)]
+    )  # (depth, n)
+    return gathered.min(axis=0)
+
+
+def cms_merge(a: CountMin, b: CountMin) -> CountMin:
+    return a.replace(table=a.table + b.table, total=a.total + b.total)
+
+
+def cms_psum(state: CountMin, axis_name: str) -> CountMin:
+    """Cluster-wide merge: one all-reduce over the mesh axis — the TPU
+    equivalent of the reference's client-side snapshot merge
+    (pkg/snapshotcombiner/snapshotcombiner.go:56-106)."""
+    return state.replace(
+        table=jax.lax.psum(state.table, axis_name),
+        total=jax.lax.psum(state.total, axis_name),
+    )

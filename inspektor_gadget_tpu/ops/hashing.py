@@ -1,0 +1,59 @@
+"""32-bit hashing primitives for sketch keys.
+
+TPU-first: all device-side hashing is uint32 (native VPU width; JAX x64 off).
+64-bit FNV-1a hashes from the host tensorizer fold to 32 bits at ingest;
+per-row sketch hashes derive via multiply-shift universal hashing with a
+murmur3 finalizer for avalanche.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Odd multipliers for multiply-shift hashing, fixed so sketches built in
+# different processes/hosts merge coherently (same hash family everywhere).
+# Rows beyond the seed table derive deterministically via splitmix32.
+_SEED_MULTIPLIERS = [
+    0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F,
+    0x165667B1, 0xD3A2646D, 0xFD7046C5, 0xB55A4F09,
+]
+
+
+def _row_multiplier(row: int) -> np.uint32:
+    if row < len(_SEED_MULTIPLIERS):
+        return np.uint32(_SEED_MULTIPLIERS[row])
+    z = (row * 0x9E3779B9 + 0x6A09E667) & 0xFFFFFFFF
+    z = ((z ^ (z >> 16)) * 0x85EBCA6B) & 0xFFFFFFFF
+    z = ((z ^ (z >> 13)) * 0xC2B2AE35) & 0xFFFFFFFF
+    return np.uint32((z ^ (z >> 16)) | 1)  # force odd
+
+
+def fold64_to_32(keys64: np.ndarray) -> np.ndarray:
+    """Host-side fold of uint64 FNV-1a hashes to uint32 (xor-fold)."""
+    k = np.asarray(keys64, dtype=np.uint64)
+    return ((k >> np.uint64(32)) ^ (k & np.uint64(0xFFFFFFFF))).astype(np.uint32)
+
+
+def fmix32(h: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 32-bit finalizer: full avalanche on uint32 lanes."""
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def multiply_shift(keys: jnp.ndarray, row: int, log2_width: int) -> jnp.ndarray:
+    """Row `row`'s bucket index in [0, 2**log2_width): multiply-shift over
+    uint32 with a finalizer, keeping the top bits (the well-mixed ones)."""
+    salt = jnp.uint32((row * 0x9E3779B9) & 0xFFFFFFFF)
+    h = fmix32(keys.astype(jnp.uint32) * _row_multiplier(row) + salt)
+    return (h >> (32 - log2_width)).astype(jnp.int32)
+
+
+def row_hashes(keys: jnp.ndarray, depth: int, log2_width: int) -> jnp.ndarray:
+    """(depth, n) bucket indices for a batch of uint32 keys."""
+    return jnp.stack([multiply_shift(keys, d, log2_width) for d in range(depth)])

@@ -1,0 +1,87 @@
+"""SketchBundle: the per-node analytics state updated once per event batch.
+
+This is the device-side hot loop of the framework — the TPU analogue of the
+reference's per-event Go hot loop (perf.Reader.Read → enrich → format,
+pkg/gadgets/trace/exec/tracer/tracer.go:134-188). One jitted step absorbs a
+fixed-shape batch into all sketches; with jax.block_until_ready only at
+harvest points, ingest stays pipelined.
+
+Key streams per batch (all uint32, padded to fixed length with mask):
+  hh_keys       heavy-hitter keys (count-min + top-k), e.g. hash(comm)
+  distinct_keys HLL distinct stream, e.g. hash(saddr,daddr,dport)
+  dist_keys     distribution stream (entropy + anomaly vector), e.g. syscall
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from .countmin import CountMin, cms_init, cms_merge, cms_update
+from .entropy import EntropySketch, entropy_init, entropy_merge, entropy_update
+from .hll import HLL, hll_init, hll_merge, hll_update
+from .topk import TopK, topk_init, topk_merge, topk_update
+
+
+@flax.struct.dataclass
+class SketchBundle:
+    cms: CountMin
+    hll: HLL
+    entropy: EntropySketch
+    topk: TopK
+    events: jnp.ndarray  # () float32 — total events absorbed (masked count)
+    drops: jnp.ndarray   # () float32 — upstream loss accounting carried along
+
+
+def bundle_init(
+    *,
+    depth: int = 4,
+    log2_width: int = 16,
+    hll_p: int = 14,
+    entropy_log2_width: int = 12,
+    k: int = 128,
+) -> SketchBundle:
+    return SketchBundle(
+        cms=cms_init(depth, log2_width),
+        hll=hll_init(hll_p),
+        entropy=entropy_init(entropy_log2_width),
+        topk=topk_init(k),
+        events=jnp.zeros((), jnp.float32),
+        drops=jnp.zeros((), jnp.float32),
+    )
+
+
+def bundle_update(
+    bundle: SketchBundle,
+    hh_keys: jnp.ndarray,
+    distinct_keys: jnp.ndarray,
+    dist_keys: jnp.ndarray,
+    mask: jnp.ndarray,
+    drops: jnp.ndarray | None = None,
+) -> SketchBundle:
+    w = mask.astype(jnp.int32)
+    cms = cms_update(bundle.cms, hh_keys, w)
+    return bundle.replace(
+        cms=cms,
+        hll=hll_update(bundle.hll, distinct_keys, mask),
+        entropy=entropy_update(bundle.entropy, dist_keys, w.astype(jnp.float32)),
+        topk=topk_update(bundle.topk, cms, hh_keys, mask),
+        events=bundle.events + mask.sum(dtype=jnp.float32),
+        drops=bundle.drops + (drops if drops is not None else 0.0),
+    )
+
+
+def bundle_merge(a: SketchBundle, b: SketchBundle) -> SketchBundle:
+    cms = cms_merge(a.cms, b.cms)
+    return SketchBundle(
+        cms=cms,
+        hll=hll_merge(a.hll, b.hll),
+        entropy=entropy_merge(a.entropy, b.entropy),
+        topk=topk_merge(a.topk, b.topk, cms),
+        events=a.events + b.events,
+        drops=a.drops + b.drops,
+    )
+
+
+bundle_update_jit = jax.jit(bundle_update, donate_argnums=0)

@@ -1,0 +1,80 @@
+"""Streaming top-k heavy-hitter candidate table.
+
+Role: the `top` gadget plane (ref: pkg/gadgets/top/* drain exact BPF stat
+maps each interval; sorting/truncation happens in pkg/parser + columns/sort).
+Here a fixed-size candidate table of (key, count) pairs rides on the count-min
+sketch: each batch refreshes CMS estimates for both the incoming keys and the
+current candidates, dedupes by key with a sort, and keeps the top-k by
+estimate via jax.lax.top_k — all static shapes, fully jittable.
+
+Distributed merge: all_gather candidate tables over the mesh axis, refresh
+against the psum-merged CMS, re-take top-k.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from .countmin import CountMin, cms_query
+
+
+@flax.struct.dataclass
+class TopK:
+    keys: jnp.ndarray    # (k,) uint32 candidate keys (0 = empty slot)
+    counts: jnp.ndarray  # (k,) int32 estimated counts
+
+
+def topk_init(k: int = 128) -> TopK:
+    return TopK(keys=jnp.zeros(k, dtype=jnp.uint32), counts=jnp.zeros(k, dtype=jnp.int32))
+
+
+def _dedupe_topk(keys: jnp.ndarray, counts: jnp.ndarray, k: int) -> TopK:
+    """Keep the best-counted unique keys: sort by (key, -count) to group
+    duplicates with each run's max count first, keep the first of each run,
+    then top_k by count."""
+    order = jnp.lexsort((-counts, keys))
+    sk, sc = keys[order], counts[order]
+    first = jnp.concatenate([jnp.array([True]), sk[1:] != sk[:-1]])
+    valid = first & (sk != 0)
+    sc = jnp.where(valid, sc, -1)
+    top_counts, top_idx = jax.lax.top_k(sc, k)
+    top_keys = sk[top_idx]
+    empty = top_counts < 0
+    return TopK(
+        keys=jnp.where(empty, jnp.uint32(0), top_keys),
+        counts=jnp.where(empty, 0, top_counts),
+    )
+
+
+def topk_update(state: TopK, cms: CountMin, batch_keys: jnp.ndarray,
+                mask: jnp.ndarray | None = None) -> TopK:
+    """Refresh candidates against a CMS that has already absorbed the batch."""
+    bk = batch_keys.astype(jnp.uint32)
+    if mask is not None:
+        bk = jnp.where(mask, bk, jnp.uint32(0))
+    all_keys = jnp.concatenate([state.keys, bk])
+    est = cms_query(cms, all_keys)
+    est = jnp.where(all_keys == 0, -1, est).astype(jnp.int32)
+    return _dedupe_topk(all_keys, est, state.keys.shape[0])
+
+
+def topk_merge(a: TopK, b: TopK, cms: CountMin | None = None) -> TopK:
+    keys = jnp.concatenate([a.keys, b.keys])
+    if cms is not None:
+        counts = jnp.where(keys == 0, -1, cms_query(cms, keys)).astype(jnp.int32)
+    else:
+        counts = jnp.concatenate([a.counts, b.counts])
+    return _dedupe_topk(keys, counts, a.keys.shape[0])
+
+
+def topk_gather_merge(state: TopK, cms_merged: CountMin, axis_name: str) -> TopK:
+    """Mesh-wide merge: all_gather candidates, refresh vs merged CMS, re-rank."""
+    keys = jax.lax.all_gather(state.keys, axis_name).reshape(-1)
+    counts = jnp.where(keys == 0, -1, cms_query(cms_merged, keys)).astype(jnp.int32)
+    return _dedupe_topk(keys, counts, state.keys.shape[0])
+
+
+def topk_values(state: TopK) -> tuple[jnp.ndarray, jnp.ndarray]:
+    return state.keys, state.counts

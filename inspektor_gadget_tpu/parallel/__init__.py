@@ -1,0 +1,25 @@
+"""Device meshes, shardings, and cluster-wide sketch merges.
+
+The reference's distributed plane is a goroutine-per-pod gRPC fan-out with
+client-side JSON merging (pkg/runtime/grpc/grpc-runtime.go:185-239,
+pkg/snapshotcombiner). The TPU-native redesign keeps gRPC as control plane
+only (see agent/) and moves aggregation onto the mesh: every node's sketch
+state lives on its chips; a cluster merge is one psum/pmax/all_gather over
+the 'node' axis riding ICI — the snapshotcombiner's ticker merge becomes an
+epoch-keyed all-reduce.
+"""
+
+from .mesh import make_mesh, node_axis, MeshSpec
+from .cluster import (
+    cluster_sketch_step,
+    cluster_merge,
+    make_cluster_step,
+    ClusterState,
+    cluster_init,
+)
+
+__all__ = [
+    "make_mesh", "node_axis", "MeshSpec",
+    "cluster_sketch_step", "cluster_merge", "make_cluster_step",
+    "ClusterState", "cluster_init",
+]

@@ -1,0 +1,137 @@
+"""Cluster-wide sketch pipeline: per-node updates + collective merges.
+
+This is the distributed heart of the framework — the TPU equivalent of the
+reference's fan-out/merge runtime (pkg/runtime/grpc/grpc-runtime.go:185-239
+merging one JSON stream per node; pkg/snapshotcombiner's TTL ticker merge).
+
+Design: each mesh 'node' shard holds its own SketchBundle (sketch arrays are
+*sharded* over the node axis — state lives where events land). One jitted
+`cluster_step` under shard_map:
+  1. absorbs that node's event batch into its local bundle,
+  2. trains the shared autoencoder data-parallel (pmean grads),
+  3. computes the *merged* cluster view (psum CMS/entropy, pmax HLL,
+     all_gather+rerank top-k) — returned as a replicated summary without
+     ever moving raw events off-node.
+
+The merged view is recomputed per harvest tick, not per batch — matching the
+reference's interval semantics (snapshotcombiner ticker) while keeping the
+hot path collective-free.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.autoencoder import AnomalyScorer, ae_train_step, normalize_counts
+from ..ops.countmin import cms_psum
+from ..ops.entropy import entropy_psum
+from ..ops.hll import hll_pmax
+from ..ops.sketches import SketchBundle, bundle_init, bundle_update
+from ..ops.topk import topk_gather_merge
+from .mesh import NODE_AXIS
+
+
+@flax.struct.dataclass
+class ClusterState:
+    """Per-node bundles (sharded over 'node') + replicated scorer."""
+
+    bundle: SketchBundle
+    scorer: AnomalyScorer
+
+
+def cluster_init(mesh: Mesh, scorer: AnomalyScorer, **bundle_kw) -> ClusterState:
+    """Materialize state with the right shardings: bundle arrays get a
+    leading node-axis dim (one bundle per node), scorer replicates."""
+    n = mesh.shape[NODE_AXIS]
+
+    def stack(x):
+        return jax.device_put(
+            jnp.broadcast_to(x, (n,) + x.shape),
+            NamedSharding(mesh, P(NODE_AXIS)),
+        )
+
+    bundle = jax.tree.map(stack, bundle_init(**bundle_kw))
+    scorer = jax.device_put(scorer, NamedSharding(mesh, P()))
+    return ClusterState(bundle=bundle, scorer=scorer)
+
+
+def cluster_sketch_step(
+    state: ClusterState,
+    hh_keys: jnp.ndarray,      # (n_nodes, batch) uint32
+    distinct_keys: jnp.ndarray,
+    dist_keys: jnp.ndarray,
+    mask: jnp.ndarray,         # (n_nodes, batch) bool
+    ae_batch: jnp.ndarray,     # (n_nodes, rows, input_dim) float32 counts
+) -> tuple[ClusterState, jnp.ndarray]:
+    """Per-node shard body (runs under shard_map; leading node dim is 1)."""
+    bundle = jax.tree.map(lambda x: x[0], state.bundle)
+    bundle = bundle_update(bundle, hh_keys[0], distinct_keys[0], dist_keys[0], mask[0])
+    scorer, loss = ae_train_step(
+        state.scorer, normalize_counts(ae_batch[0]), axis_name=NODE_AXIS
+    )
+    bundle = jax.tree.map(lambda x: x[None], bundle)
+    return ClusterState(bundle=bundle, scorer=scorer), loss
+
+
+def cluster_merge(bundle: SketchBundle) -> SketchBundle:
+    """Collective merge of per-node bundles into the cluster view (runs
+    under shard_map over the node axis). CMS/entropy psum, HLL pmax, top-k
+    all_gather + re-rank vs the merged CMS."""
+    local = jax.tree.map(lambda x: x[0], bundle)
+    cms = cms_psum(local.cms, NODE_AXIS)
+    merged = SketchBundle(
+        cms=cms,
+        hll=hll_pmax(local.hll, NODE_AXIS),
+        entropy=entropy_psum(local.entropy, NODE_AXIS),
+        topk=topk_gather_merge(local.topk, cms, NODE_AXIS),
+        events=jax.lax.psum(local.events, NODE_AXIS),
+        drops=jax.lax.psum(local.drops, NODE_AXIS),
+    )
+    return merged
+
+
+def _specs_like(tree, spec):
+    """PartitionSpec pytree with `spec` at every array leaf of `tree`."""
+    return jax.tree.map(lambda _: spec, tree)
+
+
+def make_cluster_step(mesh: Mesh, state: ClusterState):
+    """Jitted SPMD pair: (step, merge).
+
+    step(state, hh, distinct, dist, mask, ae_batch) -> (state, loss)
+      per-node sketch update + DP autoencoder train; no cross-node
+      collectives except the grad pmean.
+    merge(bundle_sharded) -> replicated cluster SketchBundle
+      the harvest-tick collective (snapshotcombiner analogue).
+    """
+    state_specs = ClusterState(
+        bundle=_specs_like(state.bundle, P(NODE_AXIS)),
+        scorer=_specs_like(state.scorer, P()),
+    )
+    batch_spec = P(NODE_AXIS)
+
+    step = jax.jit(
+        jax.shard_map(
+            cluster_sketch_step,
+            mesh=mesh,
+            in_specs=(state_specs, batch_spec, batch_spec, batch_spec,
+                      batch_spec, batch_spec),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        ),
+        donate_argnums=0,
+    )
+
+    merge = jax.jit(
+        jax.shard_map(
+            cluster_merge,
+            mesh=mesh,
+            in_specs=(_specs_like(state.bundle, P(NODE_AXIS)),),
+            out_specs=_specs_like(jax.tree.map(lambda x: x[0], state.bundle), P()),
+            check_vma=False,
+        )
+    )
+    return step, merge

@@ -1,0 +1,56 @@
+"""Mesh construction and sharding specs.
+
+Axes:
+  node   data-parallel over event streams (one shard per node/chip group) —
+         sketch updates are per-node, merges are collectives over this axis.
+  model  tensor-parallel axis for the autoencoder matmuls (used when the
+         slice has more chips than event streams).
+
+Within one pod slice both axes ride ICI; across slices the node axis maps
+onto DCN — mirroring the reference's node-local (unix socket) vs cluster
+(kubectl-exec gRPC) split (pkg/gadgettracermanager main.go:66-67 vs
+pkg/runtime/grpc/k8s-exec-dialer.go).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "node"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    n_nodes: int
+    n_model: int = 1
+
+
+def node_axis() -> str:
+    return NODE_AXIS
+
+
+def make_mesh(n_nodes: int | None = None, n_model: int = 1,
+              devices=None) -> Mesh:
+    """Build a (node, model) mesh. Defaults: all local devices on the node
+    axis. On a real multi-host slice, pass jax.devices() after
+    jax.distributed.initialize()."""
+    if devices is None:
+        devices = jax.devices()
+    if n_nodes is None:
+        n_nodes = len(devices) // n_model
+    devs = np.asarray(devices[: n_nodes * n_model]).reshape(n_nodes, n_model)
+    return Mesh(devs, (NODE_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Event batches shard over the node axis (leading dim = node)."""
+    return NamedSharding(mesh, P(NODE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

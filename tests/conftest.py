@@ -1,17 +1,24 @@
-"""Test configuration: force an 8-device virtual CPU platform before JAX init.
+"""Test configuration: force an 8-device virtual CPU platform before JAX use.
 
 Mirrors the reference's test strategy of kernel-real-but-container-free unit
 tests (reference internal/test/runner.go:103-218 unshares namespaces to fake
 containers); here the analogue is a virtual 8-device CPU mesh standing in for
 a TPU pod slice so sharding/psum paths are exercised without TPU hardware.
+
+Note: the environment's sitecustomize pre-imports jax with the axon TPU
+platform, so env vars alone are ignored — jax.config.update must run before
+first backend use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

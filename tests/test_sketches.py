@@ -1,0 +1,193 @@
+"""Sketch-plane tests: accuracy bounds, mergeability, static-shape jit.
+
+Accuracy targets from BASELINE.md: <1% heavy-hitter error; HLL standard
+error ~1.04/sqrt(m) (p=14 → ~0.8%).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from inspektor_gadget_tpu.ops import (
+    bundle_init, bundle_update, bundle_merge,
+    cms_init, cms_update, cms_query, cms_merge,
+    hll_init, hll_update, hll_estimate, hll_merge,
+    entropy_init, entropy_update, entropy_estimate, entropy_merge,
+    topk_init, topk_update, topk_merge,
+    fold64_to_32,
+)
+from inspektor_gadget_tpu.ops.sketches import bundle_update_jit
+
+
+def zipf_keys(rng, n, vocab=1000, a=1.5):
+    return rng.zipf(a, size=n).clip(1, vocab).astype(np.uint32) * np.uint32(2654435761)
+
+
+def test_fold64():
+    k = np.array([0x123456789ABCDEF0], dtype=np.uint64)
+    assert fold64_to_32(k)[0] == np.uint32(0x12345678 ^ 0x9ABCDEF0)
+
+
+# -- count-min ---------------------------------------------------------------
+
+def test_cms_exact_on_sparse():
+    cms = cms_init(depth=4, log2_width=12)
+    keys = jnp.array([1, 2, 3, 1, 1, 2], dtype=jnp.uint32)
+    cms = cms_update(cms, keys)
+    q = cms_query(cms, jnp.array([1, 2, 3, 99], dtype=jnp.uint32))
+    assert q[0] == 3 and q[1] == 2 and q[2] == 1
+    assert q[3] <= 1  # overestimate only, tiny on sparse table
+    assert float(cms.total) == 6
+
+
+def test_cms_weighted_and_masked():
+    cms = cms_init(depth=4, log2_width=10)
+    keys = jnp.array([5, 5, 7, 7], dtype=jnp.uint32)
+    w = jnp.array([2, 3, 1, 0], dtype=jnp.int32)  # last slot masked out
+    cms = cms_update(cms, keys, w)
+    q = cms_query(cms, jnp.array([5, 7], dtype=jnp.uint32))
+    assert q[0] == 5 and q[1] == 1
+
+
+def test_cms_heavy_hitter_error_under_1pct():
+    rng = np.random.default_rng(0)
+    keys = zipf_keys(rng, 200_000)
+    cms = cms_init(depth=4, log2_width=16)
+    cms = cms_update(cms, jnp.asarray(keys))
+    uniq, exact = np.unique(keys, return_counts=True)
+    heavy = exact >= 0.001 * len(keys)
+    est = np.asarray(cms_query(cms, jnp.asarray(uniq)))
+    rel_err = np.abs(est[heavy] - exact[heavy]) / exact[heavy]
+    assert rel_err.max() < 0.01
+
+
+def test_cms_merge_equals_union():
+    rng = np.random.default_rng(1)
+    k1, k2 = zipf_keys(rng, 5000), zipf_keys(rng, 5000)
+    a = cms_update(cms_init(4, 14), jnp.asarray(k1))
+    b = cms_update(cms_init(4, 14), jnp.asarray(k2))
+    merged = cms_merge(a, b)
+    union = cms_update(cms_update(cms_init(4, 14), jnp.asarray(k1)), jnp.asarray(k2))
+    assert jnp.array_equal(merged.table, union.table)
+
+
+# -- HLL ---------------------------------------------------------------------
+
+def test_hll_estimate_within_2pct():
+    rng = np.random.default_rng(2)
+    n = 50_000
+    keys = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+    distinct = len(np.unique(keys))
+    h = hll_update(hll_init(p=14), jnp.asarray(keys))
+    est = float(hll_estimate(h))
+    assert abs(est - distinct) / distinct < 0.02
+
+
+def test_hll_small_range_linear_counting():
+    keys = jnp.arange(1, 101, dtype=jnp.uint32) * jnp.uint32(2654435761)
+    h = hll_update(hll_init(p=12), keys)
+    est = float(hll_estimate(h))
+    assert abs(est - 100) < 3
+
+
+def test_hll_merge_is_union():
+    rng = np.random.default_rng(3)
+    k1 = rng.integers(0, 2**32, 10_000, dtype=np.uint32)
+    k2 = rng.integers(0, 2**32, 10_000, dtype=np.uint32)
+    a = hll_update(hll_init(12), jnp.asarray(k1))
+    b = hll_update(hll_init(12), jnp.asarray(k2))
+    m = hll_merge(a, b)
+    both = hll_update(hll_update(hll_init(12), jnp.asarray(k1)), jnp.asarray(k2))
+    assert jnp.array_equal(m.registers, both.registers)
+
+
+def test_hll_mask():
+    keys = jnp.arange(1, 65, dtype=jnp.uint32)
+    mask = jnp.arange(64) < 32
+    h = hll_update(hll_init(12), keys, mask)
+    assert abs(float(hll_estimate(h)) - 32) < 3
+
+
+# -- entropy -----------------------------------------------------------------
+
+def test_entropy_uniform_vs_skewed():
+    uniform = jnp.arange(256, dtype=jnp.uint32)
+    e1 = entropy_update(entropy_init(12), uniform)
+    constant = jnp.zeros(256, dtype=jnp.uint32) + 7
+    e2 = entropy_update(entropy_init(12), constant)
+    h1, h2 = float(entropy_estimate(e1)), float(entropy_estimate(e2))
+    assert abs(h1 - 8.0) < 0.2  # 256 distinct → ~8 bits
+    assert h2 == pytest.approx(0.0, abs=1e-5)
+
+
+def test_entropy_merge_additive():
+    a = entropy_update(entropy_init(10), jnp.array([1, 2], dtype=jnp.uint32))
+    b = entropy_update(entropy_init(10), jnp.array([2, 3], dtype=jnp.uint32))
+    m = entropy_merge(a, b)
+    assert float(m.counts.sum()) == 4
+
+
+# -- top-k -------------------------------------------------------------------
+
+def test_topk_finds_true_heavy_hitters():
+    rng = np.random.default_rng(4)
+    keys = zipf_keys(rng, 100_000, vocab=5000)
+    uniq, exact = np.unique(keys, return_counts=True)
+    true_top = set(uniq[np.argsort(-exact)[:10]].tolist())
+    cms = cms_init(4, 16)
+    tk = topk_init(64)
+    for i in range(0, len(keys), 8192):
+        chunk = np.zeros(8192, dtype=np.uint32)
+        got = keys[i:i + 8192]
+        chunk[: len(got)] = got
+        mask = jnp.arange(8192) < len(got)
+        cms = cms_update(cms, jnp.asarray(chunk), mask.astype(jnp.int32))
+        tk = topk_update(tk, cms, jnp.asarray(chunk), mask)
+    got_top = set(np.asarray(tk.keys)[np.argsort(-np.asarray(tk.counts))[:10]].tolist())
+    assert len(true_top & got_top) >= 9  # ≥90% of top-10 recovered
+
+
+def test_topk_dedupes_and_sorts():
+    cms = cms_init(4, 12)
+    keys = jnp.array([10, 10, 10, 20, 20, 30], dtype=jnp.uint32)
+    cms = cms_update(cms, keys)
+    tk = topk_update(topk_init(4), cms, keys)
+    kk = np.asarray(tk.keys)
+    assert len(set(kk[kk != 0].tolist())) == len(kk[kk != 0])  # unique
+    order = np.argsort(-np.asarray(tk.counts))
+    assert kk[order[0]] == 10
+
+
+def test_topk_merge():
+    cms = cms_init(4, 12)
+    k1 = jnp.array([1, 1, 1], dtype=jnp.uint32)
+    k2 = jnp.array([2, 2, 2, 2], dtype=jnp.uint32)
+    cms = cms_update(cms_update(cms, k1), k2)
+    a = topk_update(topk_init(4), cms, k1)
+    b = topk_update(topk_init(4), cms, k2)
+    m = topk_merge(a, b, cms)
+    order = np.argsort(-np.asarray(m.counts))
+    assert np.asarray(m.keys)[order[0]] == 2
+
+
+# -- bundle ------------------------------------------------------------------
+
+def test_bundle_update_and_merge():
+    rng = np.random.default_rng(5)
+    keys = jnp.asarray(zipf_keys(rng, 4096))
+    mask = jnp.ones(4096, dtype=bool)
+    b1 = bundle_update(bundle_init(), keys, keys, keys, mask)
+    b2 = bundle_update(bundle_init(), keys, keys, keys, mask)
+    m = bundle_merge(b1, b2)
+    assert float(m.events) == 8192
+    assert float(m.cms.total) == 8192
+
+
+def test_bundle_update_jit_donation():
+    b = bundle_init(log2_width=12, hll_p=10, entropy_log2_width=8, k=16)
+    keys = jnp.arange(256, dtype=jnp.uint32)
+    mask = jnp.ones(256, dtype=bool)
+    b = bundle_update_jit(b, keys, keys, keys, mask)
+    b = bundle_update_jit(b, keys, keys, keys, mask)
+    assert float(b.events) == 512
