@@ -1,0 +1,168 @@
+// C ABI for the capture layer — the cgo-bridge analogue.
+//
+// The reference ships events Go→client via gRPC streams
+// (pkg/gadget-service/service.go RunGadget) after a cgo-free in-process hop
+// from cilium/ebpf's perf reader. Here the in-process hop is this C ABI:
+// Python (ctypes) owns preallocated struct-of-arrays numpy buffers and calls
+// ig_source_pop_batch, which transposes ring slots directly into them —
+// columnar at the boundary, zero Python-side per-event work.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+
+#include "sources.cc"
+
+using namespace ig;
+
+namespace {
+
+std::mutex g_mu;
+std::unordered_map<uint64_t, Source*> g_sources;
+uint64_t g_next_id = 1;
+
+Source* lookup(uint64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_sources.find(h);
+  return it == g_sources.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Source kinds for ig_source_create.
+enum {
+  IG_SRC_SYNTH_EXEC = 1,
+  IG_SRC_SYNTH_TCP = 2,
+  IG_SRC_SYNTH_DNS = 3,
+  IG_SRC_PROC_EXEC = 100,
+  IG_SRC_PROC_TCP = 101,
+};
+
+uint64_t ig_source_create(uint32_t kind, uint64_t seed, double rate,
+                          uint32_t vocab, double zipf_s, uint32_t ring_pow2) {
+  size_t cap = 1ull << (ring_pow2 ? ring_pow2 : 20);
+  Source* s = nullptr;
+  switch (kind) {
+    case IG_SRC_SYNTH_EXEC:
+      s = new SyntheticSource(cap, EV_EXEC, seed, rate, vocab, zipf_s);
+      break;
+    case IG_SRC_SYNTH_TCP:
+      s = new SyntheticSource(cap, EV_TCP_CONNECT, seed, rate, vocab, zipf_s);
+      break;
+    case IG_SRC_SYNTH_DNS:
+      s = new SyntheticSource(cap, EV_DNS, seed, rate, vocab, zipf_s);
+      break;
+#ifdef __linux__
+    case IG_SRC_PROC_EXEC:
+      s = new ProcExecSource(cap);
+      break;
+    case IG_SRC_PROC_TCP:
+      s = new ProcTcpSource(cap);
+      break;
+#endif
+    default:
+      return 0;
+  }
+  std::lock_guard<std::mutex> g(g_mu);
+  uint64_t id = g_next_id++;
+  g_sources[id] = s;
+  return id;
+}
+
+int ig_source_start(uint64_t h) {
+  Source* s = lookup(h);
+  if (!s) return -1;
+  s->start();
+  return 0;
+}
+
+int ig_source_stop(uint64_t h) {
+  Source* s = lookup(h);
+  if (!s) return -1;
+  s->stop();
+  return 0;
+}
+
+int ig_source_destroy(uint64_t h) {
+  Source* s;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_sources.find(h);
+    if (it == g_sources.end()) return -1;
+    s = it->second;
+    g_sources.erase(it);
+  }
+  delete s;
+  return 0;
+}
+
+// Pop up to n events as struct-of-arrays into caller buffers. Any pointer
+// may be null to skip that column. Returns count popped.
+int64_t ig_source_pop_batch(uint64_t h, int64_t n, uint64_t* ts,
+                            uint64_t* key_hash, uint64_t* aux1, uint64_t* aux2,
+                            uint64_t* mntns, uint32_t* pid, uint32_t* ppid,
+                            uint32_t* uid, uint32_t* kind, char* comm /*8n*/) {
+  Source* s = lookup(h);
+  if (!s || n <= 0) return -1;
+  static thread_local std::vector<Event> tmp;
+  tmp.resize((size_t)n);
+  size_t got = s->pop(tmp.data(), (size_t)n);
+  for (size_t i = 0; i < got; i++) {
+    const Event& e = tmp[i];
+    if (ts) ts[i] = e.ts_ns;
+    if (key_hash) key_hash[i] = e.key_hash;
+    if (aux1) aux1[i] = e.aux1;
+    if (aux2) aux2[i] = e.aux2;
+    if (mntns) mntns[i] = e.mntns;
+    if (pid) pid[i] = e.pid;
+    if (ppid) ppid[i] = e.ppid;
+    if (uid) uid[i] = e.uid;
+    if (kind) kind[i] = e.kind;
+    if (comm) memcpy(comm + i * 8, e.comm, 8);
+  }
+  return (int64_t)got;
+}
+
+uint64_t ig_source_drops(uint64_t h) {
+  Source* s = lookup(h);
+  return s ? s->drops() : 0;
+}
+
+uint64_t ig_source_produced(uint64_t h) {
+  Source* s = lookup(h);
+  return s ? s->produced() : 0;
+}
+
+// Synchronous generation into caller buffers (bench path, synthetic only).
+int64_t ig_synth_generate(uint64_t h, int64_t n, uint64_t* key_hash,
+                          uint64_t* mntns, uint32_t* pid, uint32_t* uid) {
+  Source* s = lookup(h);
+  auto* syn = dynamic_cast<SyntheticSource*>(s);
+  if (!syn || n <= 0) return -1;
+  static thread_local std::vector<Event> tmp;
+  tmp.resize((size_t)n);
+  syn->generate(tmp.data(), (size_t)n);
+  for (int64_t i = 0; i < n; i++) {
+    const Event& e = tmp[i];
+    if (key_hash) key_hash[i] = e.key_hash;
+    if (mntns) mntns[i] = e.mntns;
+    if (pid) pid[i] = e.pid;
+    if (uid) uid[i] = e.uid;
+  }
+  return n;
+}
+
+int64_t ig_vocab_lookup(uint64_t h, uint64_t key, char* out, int64_t cap) {
+  Source* s = lookup(h);
+  if (!s || cap <= 0) return -1;
+  return (int64_t)s->vocab().get(key, out, (size_t)cap);
+}
+
+uint64_t ig_fnv1a64(const char* s, int64_t n) {
+  return fnv1a64(s, (size_t)n);
+}
+
+}  // extern "C"

@@ -1,0 +1,458 @@
+// Event capture sources.
+//
+// The reference's L0 is eBPF programs attached to tracepoints/kprobes
+// (SURVEY §2.4); in this build the native capture layer is C++:
+//  - SyntheticSource: deterministic zipf-distributed event generator — the
+//    replayable test/bench backbone (the analogue of the reference's
+//    namespace-unshare fake containers + event triggers,
+//    internal/test/runner.go).
+//  - ProcExecSource: real exec/exit capture via netlink proc connector
+//    (PROC_EVENT_EXEC/EXIT) with /proc polling fallback — the non-eBPF
+//    kernel boundary for trace/exec + trace/signal-ish lifecycles.
+//  - ProcTcpSource: /proc/net/tcp{,6} diff scanner for connect/accept/close
+//    (trace/tcp family without a socket filter).
+//
+// Every source owns an SPSC ring; a drop is counted, never blocks capture.
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#ifdef __linux__
+#include <dirent.h>
+#include <linux/cn_proc.h>
+#include <linux/connector.h>
+#include <linux/netlink.h>
+#include <sys/socket.h>
+#endif
+
+#include "ringbuf.h"
+
+namespace ig {
+
+static uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+// ---------------------------------------------------------------------------
+// Vocab: hash -> string side table for un-hashing heavy hitters.
+// ---------------------------------------------------------------------------
+
+class Vocab {
+ public:
+  void put(uint64_t h, const char* s, size_t n) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = map_.find(h);
+    if (it == map_.end()) map_.emplace(h, std::string(s, n));
+  }
+  // returns copied length, 0 if unknown
+  size_t get(uint64_t h, char* out, size_t cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = map_.find(h);
+    if (it == map_.end()) return 0;
+    size_t n = it->second.size() < cap ? it->second.size() : cap;
+    memcpy(out, it->second.data(), n);
+    return n;
+  }
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<uint64_t, std::string> map_;
+};
+
+// ---------------------------------------------------------------------------
+// Source base
+// ---------------------------------------------------------------------------
+
+class Source {
+ public:
+  explicit Source(size_t ring_pow2) : ring_(ring_pow2) {}
+  // Derived classes MUST stop() in their own destructor: the capture thread
+  // runs derived run() and reads derived members, which are destroyed before
+  // this base destructor joins the thread.
+  virtual ~Source() { stop(); }
+
+  virtual void start() {
+    running_.store(true);
+    thread_ = std::thread([this] { run(); });
+  }
+  virtual void stop() {
+    bool was = running_.exchange(false);
+    if (was && thread_.joinable()) thread_.join();
+  }
+
+  size_t pop(Event* out, size_t n) { return ring_.pop(out, n); }
+  uint64_t drops() const { return ring_.drops(); }
+  uint64_t produced() const { return ring_.produced(); }
+  Vocab& vocab() { return vocab_; }
+
+ protected:
+  virtual void run() = 0;
+  RingBuffer ring_;
+  Vocab vocab_;
+  std::atomic<bool> running_{false};
+  std::thread thread_;
+};
+
+// ---------------------------------------------------------------------------
+// SyntheticSource — seeded zipf generator over a comm/addr vocabulary.
+// ---------------------------------------------------------------------------
+
+class SyntheticSource : public Source {
+ public:
+  SyntheticSource(size_t ring_pow2, uint32_t kind, uint64_t seed,
+                  double rate_per_sec, uint32_t vocab_size, double zipf_s)
+      : Source(ring_pow2),
+        kind_(kind),
+        rng_(seed ? seed : 0x9E3779B97F4A7C15ull),
+        rate_(rate_per_sec),
+        vocab_size_(vocab_size ? vocab_size : 1000),
+        zipf_s_(zipf_s > 0 ? zipf_s : 1.2) {
+    // Precompute the zipf CDF once; draw via binary search.
+    cdf_.resize(vocab_size_);
+    double sum = 0;
+    for (uint32_t i = 0; i < vocab_size_; i++) {
+      sum += 1.0 / std::pow((double)(i + 1), zipf_s_);
+      cdf_[i] = sum;
+    }
+    for (auto& c : cdf_) c /= sum;
+    names_.reserve(vocab_size_);
+    for (uint32_t i = 0; i < vocab_size_; i++) {
+      char buf[24];
+      int n = snprintf(buf, sizeof(buf), "proc-%u", i);
+      names_.emplace_back(buf, n);
+      uint64_t h = fnv1a64(buf, n);
+      hashes_.push_back(h);
+      vocab_.put(h, buf, n);
+    }
+  }
+
+  ~SyntheticSource() override { stop(); }
+
+  // Fill a caller buffer directly — the zero-copy bench path (no thread).
+  size_t generate(Event* out, size_t n) {
+    for (size_t i = 0; i < n; i++) out[i] = make_event();
+    return n;
+  }
+
+ protected:
+  void run() override {
+    // Paced producer: emit in 1ms chunks at the requested rate.
+    const double per_ms = rate_ / 1000.0;
+    double carry = 0;
+    while (running_.load(std::memory_order_relaxed)) {
+      carry += per_ms;
+      size_t n = (size_t)carry;
+      carry -= (double)n;
+      for (size_t i = 0; i < n; i++) ring_.push(make_event());
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+
+ private:
+  uint64_t next_rand() {  // splitmix64
+    uint64_t z = (rng_ += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  }
+
+  uint32_t zipf_draw() {
+    double u = (double)(next_rand() >> 11) * (1.0 / 9007199254740992.0);
+    auto it = std::lower_bound(cdf_.begin(), cdf_.end(), u);
+    return (uint32_t)(it - cdf_.begin());
+  }
+
+  Event make_event() {
+    Event ev{};
+    uint32_t idx = zipf_draw();
+    ev.ts_ns = now_ns();
+    ev.key_hash = hashes_[idx];
+    ev.pid = 1000 + (uint32_t)(next_rand() % 50000);
+    ev.ppid = 1;
+    ev.uid = (uint32_t)(next_rand() % 4);
+    ev.kind = kind_;
+    ev.mntns = 4026531840ull + idx % 64;  // 64 fake containers
+    ev.aux1 = next_rand();                // e.g. addresses / bytes
+    ev.aux2 = next_rand() & 0xFFFF;       // e.g. port / flags
+    const std::string& nm = names_[idx];
+    size_t n = nm.size() < sizeof(ev.comm) ? nm.size() : sizeof(ev.comm) - 1;
+    memcpy(ev.comm, nm.data(), n);
+    return ev;
+  }
+
+  uint32_t kind_;
+  uint64_t rng_;
+  double rate_;
+  uint32_t vocab_size_;
+  double zipf_s_;
+  std::vector<double> cdf_;
+  std::vector<std::string> names_;
+  std::vector<uint64_t> hashes_;
+};
+
+#ifdef __linux__
+
+// ---------------------------------------------------------------------------
+// ProcExecSource — netlink proc connector exec/exit events, /proc fallback.
+// ---------------------------------------------------------------------------
+
+class ProcExecSource : public Source {
+ public:
+  explicit ProcExecSource(size_t ring_pow2) : Source(ring_pow2) {}
+  ~ProcExecSource() override { stop(); }
+
+ protected:
+  void run() override {
+    if (!run_netlink()) run_procfs();
+  }
+
+ private:
+  void fill_from_proc(Event& ev, uint32_t pid) {
+    char path[64], buf[256];
+    snprintf(path, sizeof(path), "/proc/%u/comm", pid);
+    int fd = open(path, O_RDONLY);
+    ssize_t n = 0;
+    if (fd >= 0) {
+      n = read(fd, buf, sizeof(buf) - 1);
+      close(fd);
+    }
+    if (n > 0 && buf[n - 1] == '\n') n--;
+    if (n <= 0) {
+      n = snprintf(buf, sizeof(buf), "pid-%u", pid);
+    }
+    ev.key_hash = fnv1a64(buf, (size_t)n);
+    vocab_.put(ev.key_hash, buf, (size_t)n);
+    size_t c = (size_t)n < sizeof(ev.comm) - 1 ? (size_t)n : sizeof(ev.comm) - 1;
+    memcpy(ev.comm, buf, c);
+    // mntns from /proc/<pid>/ns/mnt symlink: "mnt:[4026531840]"
+    snprintf(path, sizeof(path), "/proc/%u/ns/mnt", pid);
+    char link[64];
+    ssize_t ln = readlink(path, link, sizeof(link) - 1);
+    if (ln > 0) {
+      link[ln] = 0;
+      const char* lb = strchr(link, '[');
+      if (lb) ev.mntns = strtoull(lb + 1, nullptr, 10);
+    }
+  }
+
+  bool run_netlink() {
+    int sock = socket(PF_NETLINK, SOCK_DGRAM | SOCK_NONBLOCK, NETLINK_CONNECTOR);
+    if (sock < 0) return false;
+    struct sockaddr_nl addr {};
+    addr.nl_family = AF_NETLINK;
+    addr.nl_groups = CN_IDX_PROC;
+    addr.nl_pid = (uint32_t)getpid();
+    if (bind(sock, (struct sockaddr*)&addr, sizeof(addr)) < 0) {
+      close(sock);
+      return false;
+    }
+    // subscribe: PROC_CN_MCAST_LISTEN. cn_msg ends in a flexible array
+    // member, so the request is assembled in a flat buffer.
+    char req[NLMSG_LENGTH(sizeof(struct cn_msg) + sizeof(enum proc_cn_mcast_op))];
+    memset(req, 0, sizeof(req));
+    struct nlmsghdr* hdr = (struct nlmsghdr*)req;
+    hdr->nlmsg_len = sizeof(req);
+    hdr->nlmsg_type = NLMSG_DONE;
+    hdr->nlmsg_pid = (uint32_t)getpid();
+    struct cn_msg* msg = (struct cn_msg*)NLMSG_DATA(hdr);
+    msg->id.idx = CN_IDX_PROC;
+    msg->id.val = CN_VAL_PROC;
+    msg->len = sizeof(enum proc_cn_mcast_op);
+    *(enum proc_cn_mcast_op*)msg->data = PROC_CN_MCAST_LISTEN;
+    if (send(sock, req, sizeof(req), 0) < 0) {
+      close(sock);
+      return false;
+    }
+    char buf[4096];
+    bool got_any = false;
+    uint64_t start = now_ns();
+    while (running_.load(std::memory_order_relaxed)) {
+      ssize_t len = recv(sock, buf, sizeof(buf), 0);
+      if (len <= 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          // If netlink stays silent for 2s with no permission, fall back.
+          if (!got_any && now_ns() - start > 2000000000ull) {
+            close(sock);
+            return false;
+          }
+          std::this_thread::sleep_for(std::chrono::milliseconds(5));
+          continue;
+        }
+        break;
+      }
+      for (struct nlmsghdr* h = (struct nlmsghdr*)buf; NLMSG_OK(h, (size_t)len);
+           h = NLMSG_NEXT(h, len)) {
+        struct cn_msg* cn = (struct cn_msg*)NLMSG_DATA(h);
+        struct proc_event* pe = (struct proc_event*)cn->data;
+        Event ev{};
+        ev.ts_ns = now_ns();
+        got_any = true;
+        if (pe->what == proc_event::PROC_EVENT_EXEC) {
+          ev.kind = EV_EXEC;
+          ev.pid = (uint32_t)pe->event_data.exec.process_pid;
+          fill_from_proc(ev, ev.pid);
+          ring_.push(ev);
+        } else if (pe->what == proc_event::PROC_EVENT_EXIT) {
+          ev.kind = EV_EXIT;
+          ev.pid = (uint32_t)pe->event_data.exit.process_pid;
+          ev.aux2 = (uint64_t)pe->event_data.exit.exit_code;
+          ring_.push(ev);
+        }
+      }
+    }
+    close(sock);
+    return true;
+  }
+
+  void run_procfs() {
+    // Poll /proc for new pids at 50Hz — the BCC-less fallback flavour
+    // (role analogue of pkg/standardgadgets' subprocess fallback).
+    std::set<uint32_t> seen;
+    bool first = true;
+    while (running_.load(std::memory_order_relaxed)) {
+      DIR* d = opendir("/proc");
+      if (!d) return;
+      std::set<uint32_t> cur;
+      struct dirent* de;
+      while ((de = readdir(d))) {
+        char* end;
+        unsigned long pid = strtoul(de->d_name, &end, 10);
+        if (*end || pid == 0) continue;
+        cur.insert((uint32_t)pid);
+      }
+      closedir(d);
+      if (!first) {
+        for (uint32_t pid : cur) {
+          if (!seen.count(pid)) {
+            Event ev{};
+            ev.ts_ns = now_ns();
+            ev.kind = EV_EXEC;
+            ev.pid = pid;
+            fill_from_proc(ev, pid);
+            ring_.push(ev);
+          }
+        }
+        for (uint32_t pid : seen) {
+          if (!cur.count(pid)) {
+            Event ev{};
+            ev.ts_ns = now_ns();
+            ev.kind = EV_EXIT;
+            ev.pid = pid;
+            ring_.push(ev);
+          }
+        }
+      }
+      seen.swap(cur);
+      first = false;
+      std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// ProcTcpSource — /proc/net/tcp{,6} diff scanner.
+// ---------------------------------------------------------------------------
+
+class ProcTcpSource : public Source {
+ public:
+  explicit ProcTcpSource(size_t ring_pow2) : Source(ring_pow2) {}
+  ~ProcTcpSource() override { stop(); }
+
+ protected:
+  void run() override {
+    std::map<uint64_t, Event> known;  // inode -> last event
+    bool first = true;
+    while (running_.load(std::memory_order_relaxed)) {
+      std::map<uint64_t, Event> cur;
+      scan("/proc/net/tcp", cur);
+      scan("/proc/net/tcp6", cur);
+      if (!first) {
+        for (auto& [inode, ev] : cur) {
+          auto it = known.find(inode);
+          if (it == known.end()) {
+            Event e = ev;
+            // state 0x0A = LISTEN → accept-side socket; else connect
+            e.kind = (e.aux2 >> 32) == 0x0A ? EV_TCP_ACCEPT : EV_TCP_CONNECT;
+            ring_.push(e);
+          }
+        }
+        for (auto& [inode, ev] : known) {
+          if (!cur.count(inode)) {
+            Event e = ev;
+            e.kind = EV_TCP_CLOSE;
+            e.ts_ns = now_ns();
+            ring_.push(e);
+          }
+        }
+      }
+      known.swap(cur);
+      first = false;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+
+ private:
+  void scan(const char* path, std::map<uint64_t, Event>& out) {
+    FILE* f = fopen(path, "r");
+    if (!f) return;
+    char line[512];
+    if (!fgets(line, sizeof(line), f)) {  // header
+      fclose(f);
+      return;
+    }
+    while (fgets(line, sizeof(line), f)) {
+      unsigned long sl;
+      char local[128], remote[128];
+      unsigned state;
+      unsigned long long inode = 0;
+      // sl local rem st tx:rx tr:tm retrnsmt uid timeout inode
+      int n = sscanf(line, " %lu: %127s %127s %x %*s %*s %*s %*u %*u %llu", &sl,
+                     local, remote, &state, &inode);
+      if (n < 5 || inode == 0) continue;
+      Event ev{};
+      ev.ts_ns = now_ns();
+      unsigned long long laddr = 0, raddr = 0;
+      unsigned lport = 0, rport = 0;
+      char* colon = strrchr(local, ':');
+      if (colon) {
+        lport = (unsigned)strtoul(colon + 1, nullptr, 16);
+        laddr = strtoull(local, nullptr, 16);
+      }
+      colon = strrchr(remote, ':');
+      if (colon) {
+        rport = (unsigned)strtoul(colon + 1, nullptr, 16);
+        raddr = strtoull(remote, nullptr, 16);
+      }
+      ev.aux1 = (laddr << 32) ^ raddr;
+      ev.aux2 = ((uint64_t)state << 32) | (lport << 16) | rport;
+      char key[64];
+      int kn = snprintf(key, sizeof(key), "%llx:%x->%llx:%x", laddr, lport,
+                        raddr, rport);
+      ev.key_hash = fnv1a64(key, (size_t)kn);
+      vocab_.put(ev.key_hash, key, (size_t)kn);
+      ev.kind = EV_TCP_CONNECT;
+      out[inode] = ev;
+    }
+    fclose(f);
+  }
+};
+
+#endif  // __linux__
+
+}  // namespace ig

@@ -1,0 +1,28 @@
+"""Event sources: the capture layer feeding gadgets.
+
+Native C++ sources (native/) capture or synthesize events into lock-free
+rings; the ctypes bridge pops them as struct-of-arrays batches (bridge.py).
+A pure-Python synthetic source provides a no-toolchain fallback with the
+same interface. Replay sources make every test deterministic — the analogue
+of the reference's fake-container runners (internal/test/runner.go).
+"""
+
+from .batch import EventBatch, BATCH_COLUMNS
+from .bridge import (
+    NativeCapture,
+    native_available,
+    SRC_SYNTH_EXEC,
+    SRC_SYNTH_TCP,
+    SRC_SYNTH_DNS,
+    SRC_PROC_EXEC,
+    SRC_PROC_TCP,
+)
+from .synthetic import PySyntheticSource
+
+__all__ = [
+    "EventBatch", "BATCH_COLUMNS",
+    "NativeCapture", "native_available",
+    "SRC_SYNTH_EXEC", "SRC_SYNTH_TCP", "SRC_SYNTH_DNS",
+    "SRC_PROC_EXEC", "SRC_PROC_TCP",
+    "PySyntheticSource",
+]

@@ -1,0 +1,57 @@
+"""EventBatch: the struct-of-arrays unit of transport.
+
+Fixed-capacity columnar batches with an explicit valid-count and cumulative
+loss counters — the contract every hop preserves (capture ring → bridge →
+sketch plane → agent stream), reproducing the reference's end-to-end loss
+accounting (perf LostSamples → tracer warn events → stream EventLost →
+seq-gap checks; SURVEY §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Canonical wire columns (matches native/events.h Event layout).
+BATCH_COLUMNS: dict[str, np.dtype] = {
+    "ts": np.dtype(np.uint64),
+    "key_hash": np.dtype(np.uint64),
+    "aux1": np.dtype(np.uint64),
+    "aux2": np.dtype(np.uint64),
+    "mntns": np.dtype(np.uint64),
+    "pid": np.dtype(np.uint32),
+    "ppid": np.dtype(np.uint32),
+    "uid": np.dtype(np.uint32),
+    "kind": np.dtype(np.uint32),
+}
+
+
+@dataclasses.dataclass
+class EventBatch:
+    cols: dict[str, np.ndarray]
+    count: int                 # valid rows (rest is padding)
+    seq: int = 0               # first event's sequence number
+    drops: int = 0             # cumulative upstream drops at pop time
+    comm: np.ndarray | None = None  # (capacity, 8) uint8 display prefixes
+
+    @property
+    def capacity(self) -> int:
+        return len(next(iter(self.cols.values())))
+
+    def mask(self) -> np.ndarray:
+        m = np.zeros(self.capacity, dtype=bool)
+        m[: self.count] = True
+        return m
+
+    @classmethod
+    def alloc(cls, capacity: int, with_comm: bool = True) -> "EventBatch":
+        cols = {n: np.zeros(capacity, dtype=dt) for n, dt in BATCH_COLUMNS.items()}
+        comm = np.zeros((capacity, 8), dtype=np.uint8) if with_comm else None
+        return cls(cols=cols, count=0, comm=comm)
+
+    def comm_str(self, i: int) -> str:
+        if self.comm is None:
+            return ""
+        raw = bytes(self.comm[i])
+        return raw.split(b"\0", 1)[0].decode("utf-8", "replace")
