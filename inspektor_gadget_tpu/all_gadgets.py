@@ -1,0 +1,7 @@
+"""Import-for-side-effect registration of every gadget + operator
+(ref: pkg/all-gadgets/allgadgets.go)."""
+
+from .gadgets.trace import exec as _exec  # noqa: F401
+from .gadgets.trace import tcp as _tcp  # noqa: F401
+from .operators import localmanager as _localmanager  # noqa: F401
+from .operators import tpusketch as _tpusketch  # noqa: F401

@@ -1,0 +1,2 @@
+"""CLI frontend (ref: cmd/ig, cmd/common/registry.go — the command tree is
+generated from the gadget registry/catalog, flags from ParamDescs)."""

@@ -1,0 +1,203 @@
+"""ig-tpu CLI: auto-generated commands from the gadget registry.
+
+Reference contract: cmd/common/registry.go:46-101 builds a cobra tree with
+one command per category/gadget, flags materialized from ParamDescs
+(gadget + operators + runtime); RunE wires runtime.Init → gadgetcontext →
+parser callback → formatter (registry.go:172-346). `ig` uses the local
+runtime (cmd/ig/main.go:36-57); `--remote` switches to the gRPC fan-out
+runtime (kubectl-gadget analogue, cmd/kubectl-gadget/main.go:48-69).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+from .. import all_gadgets  # noqa: F401 — registers everything
+from ..columns import TextFormatter, parse_filters, match_event, parse_sort, sort_events
+from ..gadgets import GadgetContext, registry_clear  # noqa: F401
+from ..gadgets import registry as gadget_registry
+from ..gadgets.interface import GadgetType
+from ..operators import operators as op_registry
+from ..params import Collection, ParamError
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="ig-tpu",
+        description="TPU-native streaming observability framework",
+    )
+    sub = ap.add_subparsers(dest="category")
+
+    lp = sub.add_parser("list", help="list gadgets")
+    lp.set_defaults(func=cmd_list)
+
+    cp = sub.add_parser("catalog", help="print the full catalog as JSON")
+    cp.set_defaults(func=cmd_catalog)
+
+    from ..gadgets.registry import categories
+    for category, descs in categories().items():
+        catp = sub.add_parser(category, help=f"{category} gadgets")
+        catsub = catp.add_subparsers(dest="gadget")
+        for desc in descs:
+            gp = catsub.add_parser(desc.name, help=desc.description)
+            _add_common_flags(gp)
+            for p in desc.params().to_params():
+                d = p.desc
+                gp.add_argument(
+                    f"--{d.key}", default=d.default, dest=f"param_{d.key}",
+                    help=d.description or d.key,
+                )
+            for op in op_registry.get_all():
+                if not op.can_operate_on(desc):
+                    continue
+                for p in op.instance_params().to_params():
+                    d = p.desc
+                    gp.add_argument(
+                        f"--{op.name}-{d.key}", default=d.default,
+                        dest=f"opparam_{op.name}.{d.key}",
+                        help=f"[operator {op.name}] {d.description or d.key}",
+                    )
+            gp.set_defaults(func=cmd_run, desc=desc)
+    return ap
+
+
+def _add_common_flags(gp: argparse.ArgumentParser) -> None:
+    gp.add_argument("-o", "--output", default="columns",
+                    choices=["columns", "json"], help="output format")
+    gp.add_argument("--timeout", type=float, default=0.0,
+                    help="stop after N seconds")
+    gp.add_argument("-F", "--filter", default="",
+                    help="column filters, e.g. comm:bash,pid:>100")
+    gp.add_argument("--sort", default="", help="sort spec, e.g. -count,comm")
+    gp.add_argument("--max-rows", type=int, default=50)
+    gp.add_argument("--columns", default="", help="comma-separated columns to show")
+    gp.add_argument("--no-header", action="store_true")
+
+
+def cmd_list(args) -> int:
+    for desc in gadget_registry.get_all():
+        print(f"{desc.category:10s} {desc.name:18s} {desc.description}")
+    return 0
+
+
+def cmd_catalog(args) -> int:
+    from ..runtime.runtime import build_catalog
+    print(json.dumps(build_catalog(), indent=2))
+    return 0
+
+
+def cmd_run(args) -> int:
+    desc = args.desc
+    gadget_params = desc.params().to_params()
+    for p in list(gadget_params):
+        v = getattr(args, f"param_{p.key}", None)
+        if v is not None:
+            try:
+                gadget_params.set(p.key, v)
+            except ParamError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+
+    op_params = Collection()
+    for op in op_registry.get_all():
+        prefix = f"operator.{op.name}."
+        params = op.instance_params().to_params()
+        for p in list(params):
+            v = getattr(args, f"opparam_{op.name}.{p.key}".replace(".", "_"), None)
+            # argparse converts dest dots? keep both lookups
+            if v is None:
+                v = getattr(args, f"opparam_{op.name}.{p.key}", None)
+            if v is not None:
+                try:
+                    params.set(p.key, v)
+                except ParamError as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 2
+        op_params[prefix] = params
+
+    ctx = GadgetContext(
+        desc,
+        gadget_params=gadget_params,
+        operator_params=op_params,
+        timeout=args.timeout,
+    )
+
+    cols = ctx.columns
+    filters = parse_filters(args.filter, cols) if args.filter and cols else []
+    if args.columns and cols:
+        cols.set_visible(args.columns.split(","))
+    formatter = TextFormatter(cols) if cols else None
+
+    out = sys.stdout
+    printed_header = False
+
+    def on_event(ev):
+        nonlocal printed_header
+        if filters and not match_event(ev, filters, cols):
+            return
+        if args.output == "json":
+            out.write(cols.to_json(ev) + "\n")
+        else:
+            if not printed_header and not args.no_header:
+                out.write(formatter.header() + "\n")
+                printed_header = True
+            out.write(formatter.format_event(ev) + "\n")
+        out.flush()
+
+    def on_event_array(evs):
+        nonlocal printed_header
+        rows = [e for e in evs if not filters or match_event(e, filters, cols)]
+        if args.sort:
+            rows = sort_events(rows, parse_sort(args.sort, cols), cols)
+        rows = rows[: args.max_rows]
+        if args.output == "json":
+            out.write(json.dumps([cols.to_dict(e) for e in rows], default=str) + "\n")
+        else:
+            out.write("\n" + formatter.format_table(rows) + "\n")
+        out.flush()
+
+    from ..runtime.local import LocalRuntime
+    runtime = LocalRuntime()
+
+    def on_sigint(signum, frame):
+        ctx.cancel()
+
+    signal.signal(signal.SIGINT, on_sigint)
+    if args.timeout > 0:
+        import threading
+        threading.Thread(target=ctx.wait_for_timeout_or_done, daemon=True).start()
+
+    result = runtime.run_gadget(
+        ctx,
+        on_event=on_event if desc.gadget_type in (GadgetType.TRACE,) else None,
+        on_event_array=on_event_array
+        if desc.gadget_type == GadgetType.TRACE_INTERVALS else None,
+    )
+    errs = result.errors()
+    if errs:
+        for node, err in errs.items():
+            print(f"error on {node}: {err}", file=sys.stderr)
+        return 1
+    res = result.first()
+    if res is not None:
+        if isinstance(res, bytes):
+            sys.stdout.buffer.write(res)
+        else:
+            print(res)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = build_parser()
+    args = ap.parse_args(argv)
+    if not hasattr(args, "func"):
+        ap.print_help()
+        return 0
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

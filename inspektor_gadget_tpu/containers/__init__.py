@@ -1,0 +1,27 @@
+"""Container tracking (ref: pkg/container-collection, pkg/tracer-collection,
+pkg/container-utils, pkg/runcfanotify).
+
+ContainerCollection is the authoritative in-memory container set with a
+pubsub fan-out and an enricher chain; TracerCollection keeps per-tracer
+mntns filter sets in sync with matching containers — the BPF-map analogue
+that gates event sources by container.
+"""
+
+from .container import Container, ContainerSelector
+from .collection import ContainerCollection, EventType, PubSubEvent
+from .tracer_collection import TracerCollection
+from .options import (
+    with_fake_containers,
+    with_procfs_discovery,
+    with_node_name,
+    with_cgroup_enrichment,
+    with_linux_namespace_enrichment,
+)
+
+__all__ = [
+    "Container", "ContainerSelector",
+    "ContainerCollection", "EventType", "PubSubEvent",
+    "TracerCollection",
+    "with_fake_containers", "with_procfs_discovery", "with_node_name",
+    "with_cgroup_enrichment", "with_linux_namespace_enrichment",
+]

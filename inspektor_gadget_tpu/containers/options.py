@@ -1,0 +1,124 @@
+"""ContainerCollection initialization options.
+
+Reference contract: pkg/container-collection/options.go — ~14 functional
+options composing discovery + enrichment (WithPodInformer :199,
+WithRuncFanotify :533, WithCgroupEnrichment :570,
+WithLinuxNamespaceEnrichment :598, WithNodeName :669, ...). In this build
+the discovery backends are: explicit/fake containers (tests, agent RPC),
+and procfs scanning (every process group with a distinct mntns ≈ a
+container-ish workload unit on hosts without a runtime socket).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable
+
+from .collection import ContainerCollection
+from .container import Container
+
+
+def with_node_name(name: str):
+    """ref: options.go:669 WithNodeName."""
+
+    def opt(cc: ContainerCollection):
+        cc.node_name = name
+
+    return opt
+
+
+def with_fake_containers(containers: Iterable[Container]):
+    """Seed a fixed container set — the TestOnly/fixture path
+    (ref: internal/benchmarks fake containers; gadgettracermanager
+    TestOnly constructors)."""
+
+    def opt(cc: ContainerCollection):
+        for c in containers:
+            cc.add_container(c)
+
+    return opt
+
+
+def _read_ns(pid: int, ns: str) -> int:
+    try:
+        link = os.readlink(f"/proc/{pid}/ns/{ns}")
+        m = re.search(r"\[(\d+)\]", link)
+        return int(m.group(1)) if m else 0
+    except OSError:
+        return 0
+
+
+def with_cgroup_enrichment():
+    """Fill cgroup path/id from /proc (ref: options.go:570
+    WithCgroupEnrichment)."""
+
+    def enrich(c: Container) -> bool:
+        if c.pid and not c.cgroup_path:
+            try:
+                with open(f"/proc/{c.pid}/cgroup") as f:
+                    line = f.readline().strip()
+                c.cgroup_path = line.split(":", 2)[-1]
+            except OSError:
+                pass
+        return True
+
+    def opt(cc: ContainerCollection):
+        cc.add_enricher(enrich)
+
+    return opt
+
+
+def with_linux_namespace_enrichment():
+    """Fill mntns/netns from /proc/<pid>/ns (ref: options.go:598)."""
+
+    def enrich(c: Container) -> bool:
+        if c.pid:
+            if not c.mntns:
+                c.mntns = _read_ns(c.pid, "mnt")
+            if not c.netns:
+                c.netns = _read_ns(c.pid, "net")
+        return True
+
+    def opt(cc: ContainerCollection):
+        cc.add_enricher(enrich)
+
+    return opt
+
+
+def with_procfs_discovery(max_pids: int = 4096):
+    """Discover initial 'containers' by scanning /proc session leaders with
+    distinct mount namespaces — the no-runtime-socket analogue of
+    WithInitialKubernetesContainers (:320)."""
+
+    def opt(cc: ContainerCollection):
+        host_mntns = _read_ns(os.getpid(), "mnt")
+        seen: set[int] = set()
+        count = 0
+        try:
+            pids = sorted(
+                (int(d) for d in os.listdir("/proc") if d.isdigit())
+            )
+        except OSError:
+            return
+        for pid in pids:
+            if count >= max_pids:
+                break
+            mntns = _read_ns(pid, "mnt")
+            if not mntns or mntns == host_mntns or mntns in seen:
+                continue
+            seen.add(mntns)
+            try:
+                with open(f"/proc/{pid}/comm") as f:
+                    comm = f.read().strip()
+            except OSError:
+                comm = f"pid-{pid}"
+            cc.add_container(
+                Container(
+                    id=f"proc-{pid}", name=comm, pid=pid, mntns=mntns,
+                    netns=_read_ns(pid, "net"), runtime="procfs",
+                )
+            )
+            count += 1
+
+    return opt

@@ -1,0 +1,158 @@
+"""SourceTraceGadget: shared machinery for capture-backed trace gadgets.
+
+The role of the per-gadget Go tracers (pkg/gadgets/trace/*/tracer/tracer.go:
+install BPF → perf-read loop → build typed events → callback, ~200-300 LoC
+each) collapses here into one base class: pick a capture source (native or
+synthetic), pop columnar batches, apply the mntns filter mask, feed the
+batch path, and lazily decode rows for the display path. Concrete gadgets
+supply the event dataclass + a row decoder + source kind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+from ..params import ParamDesc, ParamDescs, TypeHint
+from ..sources import EventBatch, PySyntheticSource
+from ..sources.bridge import NativeCapture, native_available
+from .context import GadgetContext
+from .interface import GadgetDesc
+
+
+def source_params() -> ParamDescs:
+    """Params shared by every capture-backed gadget."""
+    return ParamDescs([
+        ParamDesc(key="source", default="auto",
+                  description="capture backend",
+                  possible_values=("auto", "native", "synthetic", "pysynthetic")),
+        ParamDesc(key="rate", default="100000", type_hint=TypeHint.FLOAT,
+                  description="synthetic event rate/sec"),
+        ParamDesc(key="vocab", default="1000", type_hint=TypeHint.INT),
+        ParamDesc(key="zipf", default="1.2", type_hint=TypeHint.FLOAT),
+        ParamDesc(key="seed", default="0", type_hint=TypeHint.INT),
+        ParamDesc(key="batch-size", default="8192", type_hint=TypeHint.INT),
+    ])
+
+
+class SourceTraceGadget:
+    """Concrete subclasses set: native_kind (proc capture), synth_kind
+    (synthetic), decode_row(batch, i) -> event."""
+
+    native_kind: int | None = None
+    synth_kind: int = 1
+
+    def __init__(self, ctx: GadgetContext):
+        self.ctx = ctx
+        self._event_handler: Callable[[Any], None] | None = None
+        self._batch_handler: Callable[[EventBatch], None] | None = None
+        self._mntns_filter: set[int] | None = None
+        p = ctx.gadget_params
+        self._mode = p.get("source").as_string() if "source" in p else "auto"
+        self._rate = p.get("rate").as_float() if "rate" in p else 100000.0
+        self._vocab = p.get("vocab").as_int() if "vocab" in p else 1000
+        self._zipf = p.get("zipf").as_float() if "zipf" in p else 1.2
+        self._seed = p.get("seed").as_int() if "seed" in p else 0
+        self._batch_size = p.get("batch-size").as_int() if "batch-size" in p else 8192
+        self.source = None
+
+    # capability protocols --------------------------------------------------
+
+    def set_event_handler(self, handler: Callable[[Any], None]) -> None:
+        self._event_handler = handler
+
+    def set_batch_handler(self, handler: Callable[[EventBatch], None]) -> None:
+        self._batch_handler = handler
+
+    def set_mntns_filter(self, mntns_ids: set[int] | None) -> None:
+        self._mntns_filter = mntns_ids
+
+    # source selection ------------------------------------------------------
+
+    def _make_source(self):
+        mode = self._mode
+        if mode == "auto":
+            if self.native_kind is not None and native_available():
+                mode = "native"
+            elif native_available():
+                mode = "synthetic"
+            else:
+                mode = "pysynthetic"
+        if mode == "native":
+            if self.native_kind is None or not native_available():
+                raise RuntimeError(
+                    f"{type(self).__name__}: native capture unavailable")
+            src = NativeCapture(self.native_kind, ring_pow2=20,
+                                batch_size=self._batch_size)
+            src.start()
+            self._threaded = True
+            return src
+        if mode == "synthetic":
+            src = NativeCapture(self.synth_kind, seed=self._seed,
+                                rate=self._rate, vocab=self._vocab,
+                                zipf_s=self._zipf, ring_pow2=20,
+                                batch_size=self._batch_size)
+            src.start()
+            self._threaded = True
+            return src
+        self._threaded = False
+        return PySyntheticSource(kind=self.synth_kind, seed=self._seed,
+                                 vocab=self._vocab, zipf_s=self._zipf,
+                                 batch_size=self._batch_size)
+
+    # run loop --------------------------------------------------------------
+
+    def run(self, ctx: GadgetContext) -> None:
+        self.source = self._make_source()
+        deadline_hit = False
+        try:
+            while not ctx.done and not deadline_hit:
+                batch = self.source.pop()
+                if batch.count == 0:
+                    if ctx.sleep_or_done(0.01):
+                        break
+                    continue
+                self._apply_filter(batch)
+                if batch.count and self._batch_handler is not None:
+                    self._batch_handler(batch)
+                if batch.count and self._event_handler is not None:
+                    for i in range(batch.count):
+                        self._event_handler(self.decode_row(batch, i))
+                if not self._threaded:
+                    # pysynthetic generates instantly; pace by rate
+                    if ctx.sleep_or_done(batch.count / max(self._rate, 1.0)):
+                        break
+        finally:
+            try:
+                self.source.stop()
+                self.source.close()
+            except Exception:
+                pass
+
+    def _apply_filter(self, batch: EventBatch) -> None:
+        """Compact the batch to rows whose mntns passes the filter — the
+        userspace analogue of the BPF-side filter_by_mnt_ns constant
+        (ref: execsnoop.bpf.c:10-35 const volatile + map lookup)."""
+        if self._mntns_filter is None or batch.count == 0:
+            return
+        mntns = batch.cols["mntns"][: batch.count]
+        allowed = np.isin(mntns, np.fromiter(self._mntns_filter, dtype=np.uint64)
+                          if self._mntns_filter else np.array([], dtype=np.uint64))
+        keep = np.flatnonzero(allowed)
+        for name, arr in batch.cols.items():
+            arr[: len(keep)] = arr[keep]
+        if batch.comm is not None:
+            batch.comm[: len(keep)] = batch.comm[keep]
+        batch.count = len(keep)
+
+    # display ---------------------------------------------------------------
+
+    def decode_row(self, batch: EventBatch, i: int) -> Any:
+        raise NotImplementedError
+
+    def resolve_key(self, key_hash: int) -> str:
+        if self.source is None:
+            return ""
+        return self.source.vocab_lookup(key_hash)

@@ -1,0 +1,1 @@
+"""trace/* gadgets — streaming event gadgets (ref: pkg/gadgets/trace/*)."""

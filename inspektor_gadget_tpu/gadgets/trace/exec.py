@@ -1,0 +1,63 @@
+"""trace/exec — process execution events.
+
+Reference: pkg/gadgets/trace/exec (execsnoop.bpf.c tracepoints on
+sys_enter/exit_execve; tracer.go:52-222 perf loop + args parsing;
+gadget.go registration). Here: native proc-connector/procfs capture or the
+synthetic generator, with the same event schema and container filtering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ...columns import col
+from ...params import ParamDescs
+from ...types import Event, WithMountNsID
+from ..interface import GadgetDesc, GadgetType
+from ..registry import register
+from ..source_gadget import SourceTraceGadget, source_params
+from ...sources.bridge import SRC_PROC_EXEC, SRC_SYNTH_EXEC
+
+
+@dataclasses.dataclass
+class ExecEvent(Event, WithMountNsID):
+    pid: int = col(0, template="pid", dtype=np.int32)
+    ppid: int = col(0, template="pid", dtype=np.int32)
+    uid: int = col(0, template="uid", dtype=np.int32)
+    comm: str = col("", template="comm")
+    retval: int = col(0, width=4, dtype=np.int32)
+    args: str = col("", width=40, hide=True)
+
+
+class TraceExec(SourceTraceGadget):
+    native_kind = SRC_PROC_EXEC
+    synth_kind = SRC_SYNTH_EXEC
+
+    def decode_row(self, batch, i) -> ExecEvent:
+        c = batch.cols
+        return ExecEvent(
+            timestamp=int(c["ts"][i]),
+            mountnsid=int(c["mntns"][i]),
+            pid=int(c["pid"][i]),
+            ppid=int(c["ppid"][i]),
+            uid=int(c["uid"][i]),
+            comm=batch.comm_str(i) or self.resolve_key(int(c["key_hash"][i])),
+            retval=0,
+        )
+
+
+@register
+class TraceExecDesc(GadgetDesc):
+    name = "exec"
+    category = "trace"
+    gadget_type = GadgetType.TRACE
+    description = "Trace new processes"
+    event_cls = ExecEvent
+
+    def params(self) -> ParamDescs:
+        return source_params()
+
+    def new_instance(self, ctx) -> TraceExec:
+        return TraceExec(ctx)

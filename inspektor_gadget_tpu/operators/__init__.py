@@ -1,0 +1,27 @@
+"""Pluggable enrichment operators (ref: pkg/operators/operators.go:40-85).
+
+Operators declare dependencies and lifecycle hooks; the runtime installs
+every operator that CanOperateOn the gadget, topologically sorted, and runs
+events through the Enrich chain. The TPU sketch operator is registered here
+like any other — any trace/top gadget can opt in (`--operator tpusketch`),
+matching the north-star integration contract of BASELINE.json.
+"""
+
+from .operators import (
+    Operator,
+    OperatorInstance,
+    register,
+    get,
+    get_all,
+    get_operators_for_gadget,
+    sort_operators,
+    clear as registry_clear,
+    install_operators,
+    Operators,
+)
+
+__all__ = [
+    "Operator", "OperatorInstance",
+    "register", "get", "get_all", "get_operators_for_gadget",
+    "sort_operators", "registry_clear", "install_operators", "Operators",
+]

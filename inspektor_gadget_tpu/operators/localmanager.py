@@ -1,0 +1,158 @@
+"""LocalManager operator: container filtering + enrichment for local runs.
+
+Reference contract: pkg/operators/localmanager/localmanager.go —
+CanOperateOn :93-121 (gadget wants a mntns map or is an Attacher),
+Instantiate :173, PreGadgetRun :208 (create per-run tracer in the
+TracerCollection, inject the mntns filter, attach containers for Attacher
+gadgets, subscribe for runtime add/remove). Instance params: containername/
+host filtering (params mirrored from localmanager gadget params).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..containers import (
+    Container,
+    ContainerCollection,
+    ContainerSelector,
+    EventType,
+    TracerCollection,
+    with_linux_namespace_enrichment,
+    with_node_name,
+    with_procfs_discovery,
+)
+from ..gadgets.context import GadgetContext
+from ..gadgets.interface import Attacher, GadgetDesc, MountNsFilterSetter
+from ..params import ParamDesc, ParamDescs, Params, TypeHint
+from .operators import Operator, OperatorInstance, register
+
+
+class LocalManager(Operator):
+    name = "localmanager"
+
+    def __init__(self):
+        self.cc: ContainerCollection | None = None
+        self.tc: TracerCollection | None = None
+
+    def global_params(self) -> ParamDescs:
+        return ParamDescs([
+            ParamDesc(key="containerd-like-discovery", default="procfs",
+                      description="container discovery backend",
+                      possible_values=("procfs", "none")),
+            ParamDesc(key="node-name", default="local"),
+        ])
+
+    def instance_params(self) -> ParamDescs:
+        # ref: localmanager.go instance params containername/host
+        return ParamDescs([
+            ParamDesc(key="containername", default="",
+                      description="filter events by container name"),
+            ParamDesc(key="host", default="false", type_hint=TypeHint.BOOL,
+                      description="include host (non-container) events"),
+        ])
+
+    def can_operate_on(self, desc: GadgetDesc) -> bool:
+        # ref: localmanager.go:93-121 — applies when the gadget can take a
+        # mntns filter or attaches per container; cheap to apply broadly for
+        # enrichment, so also cover event-emitting gadgets.
+        return True
+
+    def init(self, global_params: Params) -> None:
+        self.cc = ContainerCollection()
+        opts = [with_node_name(global_params.get("node-name").as_string()
+                               if "node-name" in global_params else "local")]
+        if ("containerd-like-discovery" in global_params
+                and global_params.get("containerd-like-discovery").as_string() == "procfs"):
+            opts.append(with_linux_namespace_enrichment())
+            opts.append(with_procfs_discovery())
+        self.cc.initialize(*opts)
+        self.tc = TracerCollection(self.cc)
+
+    def instantiate(self, ctx: GadgetContext, gadget: Any,
+                    instance_params: Params) -> "LocalManagerInstance":
+        return LocalManagerInstance(self, ctx, gadget, instance_params)
+
+
+class LocalManagerInstance(OperatorInstance):
+    def __init__(self, op: LocalManager, ctx: GadgetContext, gadget: Any,
+                 params: Params):
+        super().__init__(op.name)
+        self.op = op
+        self.ctx = ctx
+        self.gadget = gadget
+        cname = params.get("containername").as_string() if "containername" in params else ""
+        self.selector = ContainerSelector(name=cname)
+        self.host = params.get("host").as_bool() if "host" in params else False
+        self._tracer_id = f"{ctx.run_id}"
+        self._attached: list[Container] = []
+
+    def pre_gadget_run(self) -> None:
+        op = self.op
+        if op.tc is None:
+            return
+        # ref: localmanager.go:208-228 — register tracer, inject filter
+        op.tc.add_tracer(self._tracer_id, self.selector)
+        if isinstance(self.gadget, MountNsFilterSetter):
+            # filter only when a container selector is active; a bare local
+            # run traces everything including host (ref: localmanager.go
+            # host/containername param semantics)
+            if self.selector.name or self.selector.pod or self.selector.namespace:
+                self.gadget.set_mntns_filter(
+                    op.tc.tracer_mntns_set(self._tracer_id))
+        if isinstance(self.gadget, Attacher):
+            for c in op.cc.get_all(self.selector):
+                try:
+                    self.gadget.attach_container(c)
+                    self._attached.append(c)
+                except Exception as e:  # attach best-effort per container
+                    self.ctx.logger.warning("attach %s failed: %s", c.name, e)
+            op.cc.subscribe(self, self._on_container_event)
+
+    def post_gadget_run(self) -> None:
+        op = self.op
+        if op.cc is not None:
+            op.cc.unsubscribe(self)
+        if op.tc is not None:
+            op.tc.remove_tracer(self._tracer_id)
+        if isinstance(self.gadget, Attacher):
+            for c in self._attached:
+                try:
+                    self.gadget.detach_container(c)
+                except Exception:
+                    pass
+            self._attached.clear()
+
+    def _on_container_event(self, ev) -> None:
+        if not self.selector.matches(ev.container):
+            return
+        if isinstance(self.gadget, MountNsFilterSetter):
+            try:
+                self.gadget.set_mntns_filter(
+                    self.op.tc.tracer_mntns_set(self._tracer_id))
+            except KeyError:
+                pass
+        if isinstance(self.gadget, Attacher):
+            if ev.type == EventType.ADD:
+                try:
+                    self.gadget.attach_container(ev.container)
+                    self._attached.append(ev.container)
+                except Exception as e:
+                    self.ctx.logger.warning("attach failed: %s", e)
+            else:
+                try:
+                    self.gadget.detach_container(ev.container)
+                except Exception:
+                    pass
+
+    def enrich(self, event: Any) -> None:
+        if self.op.cc is not None:
+            self.op.cc.enrich_event_by_mntns(event)
+
+    def enrich_batch(self, batch: Any) -> None:
+        # columnar enrichment happens at display time via vocab; node name
+        # tagging is carried in batch metadata by the agent layer
+        pass
+
+
+register(LocalManager())

@@ -1,0 +1,232 @@
+"""TPU sketch operator — the north-star analytics plane.
+
+Any trace/top gadget can opt in (`--operator tpusketch` analogue): event
+batches flow into a per-run SketchBundle on device (count-min + HLL +
+entropy + top-k), with the autoencoder anomaly scorer optionally training
+online on per-container distributions. Harvest ticks render heavy hitters /
+distinct counts / entropy / anomaly scores as regular column rows, so the
+existing formatter path displays them (BASELINE.json: "pkg/columns and
+pkg/snapshotcombiner gain a sketch-column type").
+
+Key choices per batch (instance params): which wire column feeds the
+heavy-hitter stream (default key_hash), the distinct stream, and the
+distribution stream — so `trace exec` counts comms, `trace dns` counts
+qnames, `trace tcp` counts flows, with zero per-gadget code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..columns import col
+from ..gadgets.context import GadgetContext
+from ..gadgets.interface import BatchHandlerSetter, GadgetDesc
+from ..models.autoencoder import AEConfig, ae_init, ae_score, ae_train_step, normalize_counts
+from ..ops import bundle_init, fold64_to_32, hll_estimate, entropy_estimate
+from ..ops.countmin import cms_query
+from ..ops.sketches import bundle_update_jit
+from ..params import ParamDesc, ParamDescs, Params, TypeHint
+from ..sources.batch import EventBatch
+from .operators import Operator, OperatorInstance, register
+
+
+@dataclasses.dataclass
+class HeavyHitterRow:
+    """Rendered harvest row (sketch-column type)."""
+
+    key: str = col("", width=24)
+    count: int = col(0, width=12, dtype=np.int64)
+    share: float = col(0.0, width=8, precision=4, dtype=np.float32)
+
+
+@dataclasses.dataclass
+class SketchSummary:
+    events: int
+    drops: int
+    distinct: float
+    entropy_bits: float
+    heavy_hitters: list[tuple[int, int]]  # (key32, est count)
+    anomaly: dict[int, float] | None = None  # mntns-slot → score
+    epoch: int = 0
+
+
+class TpuSketch(Operator):
+    name = "tpusketch"
+
+    def dependencies(self) -> list[str]:
+        return []
+
+    def can_operate_on(self, desc: GadgetDesc) -> bool:
+        return True  # any batch-emitting gadget
+
+    def instance_params(self) -> ParamDescs:
+        return ParamDescs([
+            ParamDesc(key="enable", default="false", type_hint=TypeHint.BOOL,
+                      description="enable the TPU sketch plane"),
+            ParamDesc(key="depth", default="4", type_hint=TypeHint.INT),
+            ParamDesc(key="log2-width", default="16", type_hint=TypeHint.INT),
+            ParamDesc(key="hll-p", default="14", type_hint=TypeHint.INT),
+            ParamDesc(key="entropy-log2-width", default="12", type_hint=TypeHint.INT),
+            ParamDesc(key="topk", default="128", type_hint=TypeHint.INT),
+            ParamDesc(key="hh-column", default="key_hash",
+                      description="wire column feeding the heavy-hitter stream"),
+            ParamDesc(key="distinct-column", default="key_hash"),
+            ParamDesc(key="dist-column", default="key_hash",
+                      description="wire column feeding entropy/anomaly"),
+            ParamDesc(key="anomaly", default="false", type_hint=TypeHint.BOOL,
+                      description="train the autoencoder anomaly scorer"),
+            ParamDesc(key="harvest-interval", default="1s",
+                      type_hint=TypeHint.DURATION),
+        ])
+
+    def instantiate(self, ctx: GadgetContext, gadget: Any,
+                    instance_params: Params) -> "TpuSketchInstance":
+        return TpuSketchInstance(self, ctx, gadget, instance_params)
+
+
+class TpuSketchInstance(OperatorInstance):
+    def __init__(self, op: TpuSketch, ctx: GadgetContext, gadget: Any,
+                 params: Params):
+        super().__init__(op.name)
+        self.ctx = ctx
+        self.gadget = gadget
+        p = params
+        self.enabled = p.get("enable").as_bool() if "enable" in p else False
+        if not self.enabled:
+            return
+        self.hh_col = p.get("hh-column").as_string()
+        self.distinct_col = p.get("distinct-column").as_string()
+        self.dist_col = p.get("dist-column").as_string()
+        self.harvest_interval = p.get("harvest-interval").as_duration() or 1.0
+        self.bundle = bundle_init(
+            depth=p.get("depth").as_int(),
+            log2_width=p.get("log2-width").as_int(),
+            hll_p=p.get("hll-p").as_int(),
+            entropy_log2_width=p.get("entropy-log2-width").as_int(),
+            k=p.get("topk").as_int(),
+        )
+        self.anomaly_on = p.get("anomaly").as_bool()
+        self.scorer = None
+        self._container_counts: dict[int, np.ndarray] = {}
+        if self.anomaly_on:
+            self._ae_cfg = AEConfig(input_dim=1 << p.get("entropy-log2-width").as_int(),
+                                    hidden_dim=256, latent_dim=64)
+            self.scorer = ae_init(self._ae_cfg)
+        self._drops_seen = 0
+        self._last_harvest = time.monotonic()
+        self._epoch = 0
+        self.on_summary: Callable[[SketchSummary], None] | None = ctx.extra.get(
+            "on_sketch_summary")
+        self._pad = 8192  # fixed device batch shape (pad/mask)
+
+    # the columnar hot path -------------------------------------------------
+
+    def enrich_batch(self, batch: EventBatch) -> None:
+        if not self.enabled or batch.count == 0:
+            return
+        n = batch.count
+        pad = self._pad
+        while pad < n:
+            pad *= 2
+
+        def keys_for(colname: str) -> np.ndarray:
+            a = batch.cols[colname][:n]
+            if a.dtype == np.uint64:
+                k = fold64_to_32(a)
+            else:
+                k = a.astype(np.uint32)
+            out = np.zeros(pad, dtype=np.uint32)
+            out[:n] = k
+            return out
+
+        hh = keys_for(self.hh_col)
+        distinct = hh if self.distinct_col == self.hh_col else keys_for(self.distinct_col)
+        dist = hh if self.dist_col == self.hh_col else keys_for(self.dist_col)
+        mask = np.zeros(pad, dtype=bool)
+        mask[:n] = True
+        new_drops = batch.drops - self._drops_seen
+        self._drops_seen = batch.drops
+        self.bundle = bundle_update_jit(
+            self.bundle, jnp.asarray(hh), jnp.asarray(distinct),
+            jnp.asarray(dist), jnp.asarray(mask),
+            jnp.float32(max(new_drops, 0)),
+        )
+        if self.anomaly_on:
+            self._accumulate_container_dists(batch, n)
+        now = time.monotonic()
+        if now - self._last_harvest >= self.harvest_interval:
+            self._last_harvest = now
+            self.harvest()
+
+    def _accumulate_container_dists(self, batch: EventBatch, n: int) -> None:
+        dim = self._ae_cfg.input_dim
+        mntns = batch.cols["mntns"][:n]
+        keys = batch.cols[self.dist_col][:n]
+        buckets = (keys % np.uint64(dim)).astype(np.int64)
+        for ns in np.unique(mntns):
+            sel = mntns == ns
+            vec = self._container_counts.setdefault(
+                int(ns), np.zeros(dim, dtype=np.float32))
+            np.add.at(vec, buckets[sel], 1.0)
+
+    # harvest ---------------------------------------------------------------
+
+    def harvest(self) -> SketchSummary:
+        b = self.bundle
+        keys = np.asarray(b.topk.keys)
+        counts = np.asarray(b.topk.counts)
+        order = np.argsort(-counts)
+        hh = [(int(keys[i]), int(counts[i])) for i in order if keys[i] != 0]
+        anomaly = None
+        if self.anomaly_on and self._container_counts:
+            mats = np.stack(list(self._container_counts.values()))
+            x = normalize_counts(jnp.asarray(mats))
+            self.scorer, _ = ae_train_step(self.scorer, x)
+            scores = np.asarray(ae_score(self.scorer, x))
+            anomaly = {ns: float(s) for ns, s in
+                       zip(self._container_counts.keys(), scores)}
+        self._epoch += 1
+        summary = SketchSummary(
+            events=int(float(b.events)),
+            drops=int(float(b.drops)),
+            distinct=float(hll_estimate(b.hll)),
+            entropy_bits=float(entropy_estimate(b.entropy)),
+            heavy_hitters=hh,
+            anomaly=anomaly,
+            epoch=self._epoch,
+        )
+        if self.on_summary is not None:
+            self.on_summary(summary)
+        return summary
+
+    def post_gadget_run(self) -> None:
+        if self.enabled:
+            self.harvest()
+
+    # display helpers -------------------------------------------------------
+
+    def heavy_hitter_rows(self, resolve: Callable[[int], str] | None = None,
+                          k: int = 20) -> list[HeavyHitterRow]:
+        b = self.bundle
+        total = max(float(b.events), 1.0)
+        rows = []
+        keys = np.asarray(b.topk.keys)
+        counts = np.asarray(b.topk.counts)
+        order = np.argsort(-counts)[:k]
+        for i in order:
+            if keys[i] == 0:
+                continue
+            name = resolve(int(keys[i])) if resolve else f"0x{int(keys[i]):08x}"
+            rows.append(HeavyHitterRow(key=name or f"0x{int(keys[i]):08x}",
+                                       count=int(counts[i]),
+                                       share=float(counts[i]) / total))
+        return rows
+
+
+register(TpuSketch())

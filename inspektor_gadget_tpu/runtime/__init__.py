@@ -1,0 +1,9 @@
+"""Runtimes: the lifecycle abstraction shared by local and remote execution
+(ref: pkg/runtime/runtime.go:83-92 — Init, RunGadget, GetCatalog;
+CombinedGadgetResult :42-47 for per-node results/errors).
+"""
+
+from .runtime import Runtime, GadgetResult, CombinedGadgetResult
+from .local import LocalRuntime
+
+__all__ = ["Runtime", "GadgetResult", "CombinedGadgetResult", "LocalRuntime"]

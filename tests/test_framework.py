@@ -1,0 +1,182 @@
+"""Gadget framework tests: registry, operator toposort, container tracking,
+local runtime end-to-end (the §3.1 minimum slice, synthetic source).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.containers import (
+    Container,
+    ContainerCollection,
+    ContainerSelector,
+    TracerCollection,
+    with_fake_containers,
+    with_node_name,
+)
+from inspektor_gadget_tpu.gadgets import GadgetContext, get, get_all
+from inspektor_gadget_tpu.operators.operators import (
+    Operator,
+    OperatorInstance,
+    sort_operators,
+)
+from inspektor_gadget_tpu.params import Collection
+from inspektor_gadget_tpu.runtime import LocalRuntime
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_has_core_gadgets():
+    names = {(d.category, d.name) for d in get_all()}
+    assert ("trace", "exec") in names
+    assert ("trace", "tcp") in names
+    assert ("trace", "tcpconnect") in names
+
+
+def test_registry_get_unknown():
+    with pytest.raises(KeyError, match="unknown gadget"):
+        get("trace", "nope")
+
+
+# -- operator toposort (ref: operators.go:269-348 + tests) ------------------
+
+def _op(name, deps):
+    class O(Operator):
+        pass
+    o = O()
+    o.name = name
+    o.dependencies = lambda: deps
+    return o
+
+
+def test_sort_operators_orders_dependencies():
+    a, b, c = _op("a", ["b"]), _op("b", ["c"]), _op("c", [])
+    out = sort_operators([a, b, c])
+    assert [o.name for o in out] == ["c", "b", "a"]
+
+
+def test_sort_operators_cycle_detected():
+    a, b = _op("a", ["b"]), _op("b", ["a"])
+    with pytest.raises(ValueError, match="cycle"):
+        sort_operators([a, b])
+
+
+def test_sort_operators_missing_dep():
+    with pytest.raises(ValueError, match="unregistered"):
+        sort_operators([_op("a", ["ghost"])])
+
+
+# -- containers (ref: container-collection tests, match_test.go) ------------
+
+def make_cc():
+    cc = ContainerCollection()
+    cc.initialize(
+        with_node_name("node-1"),
+        with_fake_containers([
+            Container(id="c1", name="web", pod="web-pod", namespace="prod",
+                      mntns=1001, pid=100, labels={"app": "web"}),
+            Container(id="c2", name="db", pod="db-pod", namespace="prod",
+                      mntns=1002, pid=200),
+            Container(id="c3", name="web", pod="web-2", namespace="dev",
+                      mntns=1003, pid=300),
+        ]),
+    )
+    return cc
+
+
+def test_selector_matching():
+    cc = make_cc()
+    assert len(cc.get_all(ContainerSelector())) == 3
+    assert len(cc.get_all(ContainerSelector(name="web"))) == 2
+    assert len(cc.get_all(ContainerSelector(namespace="prod", name="web"))) == 1
+    assert len(cc.get_all(ContainerSelector(labels={"app": "web"}))) == 1
+    assert len(cc.get_all(ContainerSelector(labels={"app": "x"}))) == 0
+
+
+def test_mntns_lookup_and_removal_grace():
+    cc = make_cc()
+    assert cc.lookup_by_mntns(1001).name == "web"
+    cc.remove_container("c1")
+    # 2s removal cache keeps late events enrichable (ref: options.go:689)
+    assert cc.lookup_by_mntns(1001).name == "web"
+    assert len(cc) == 2
+
+
+def test_event_enrichment_by_mntns():
+    cc = make_cc()
+
+    @dataclasses.dataclass
+    class Ev:
+        mountnsid: int = 0
+        container: str = ""
+        pod: str = ""
+        namespace: str = ""
+        node: str = ""
+
+    ev = Ev(mountnsid=1002)
+    cc.enrich_event_by_mntns(ev)
+    assert ev.container == "db" and ev.pod == "db-pod" and ev.node == "node-1"
+
+
+def test_tracer_collection_tracks_membership():
+    cc = make_cc()
+    tc = TracerCollection(cc)
+    tc.add_tracer("t1", ContainerSelector(name="web"))
+    assert tc.tracer_mntns_set("t1") == {1001, 1003}
+    cc.add_container(Container(id="c4", name="web", mntns=1004, pid=400))
+    assert tc.tracer_mntns_set("t1") == {1001, 1003, 1004}
+    cc.remove_container("c1")
+    assert tc.tracer_mntns_set("t1") == {1003, 1004}
+    tc.remove_tracer("t1")
+    with pytest.raises(KeyError):
+        tc.tracer_mntns_set("t1")
+
+
+# -- local runtime end-to-end (§3.1 minimum slice) --------------------------
+
+def test_trace_exec_end_to_end_synthetic():
+    desc = get("trace", "exec")
+    params = desc.params().to_params()
+    params.set("source", "pysynthetic")
+    params.set("rate", "50000")
+    params.set("batch-size", "512")
+    ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
+    events = []
+    batches = []
+    runtime = LocalRuntime()
+    result = runtime.run_gadget(
+        ctx, on_event=events.append, on_batch=batches.append)
+    assert not result.errors()
+    assert len(events) > 100
+    assert all(e.comm.startswith("proc-") for e in events[:10])
+    assert batches and batches[0].count > 0
+
+
+def test_trace_exec_sketch_operator_end_to_end():
+    desc = get("trace", "exec")
+    params = desc.params().to_params()
+    params.set("source", "pysynthetic")
+    params.set("rate", "100000")
+    summaries = []
+    op_params = Collection()
+    from inspektor_gadget_tpu.operators.operators import get as get_op
+    sketch_params = get_op("tpusketch").instance_params().to_params()
+    sketch_params.set("enable", "true")
+    sketch_params.set("log2-width", "12")
+    sketch_params.set("hll-p", "10")
+    sketch_params.set("harvest-interval", "200ms")
+    op_params["operator.tpusketch."] = sketch_params
+    ctx = GadgetContext(
+        desc, gadget_params=params, operator_params=op_params, timeout=1.0,
+        extra={"on_sketch_summary": summaries.append},
+    )
+    result = LocalRuntime().run_gadget(ctx)
+    assert not result.errors()
+    assert summaries, "sketch operator must emit harvest summaries"
+    last = summaries[-1]
+    assert last.events > 1000
+    assert last.heavy_hitters, "must surface heavy hitters"
+    assert 0 < last.distinct < 2000
+    assert last.entropy_bits > 0
