@@ -1,0 +1,21 @@
+"""Per-node agent + distributed control plane.
+
+Reference architecture (SURVEY §2.3/§2.5): a per-node daemon
+(pkg/gadgettracermanager) exposes gRPC services over unix sockets — the
+legacy container hooks API (AddContainer/RemoveContainer/ReceiveStream) and
+the modern GadgetManager (GetInfo + RunGadget bidirectional stream,
+gadgettracermanager/api proto:121-140); the client runtime fans out one
+stream per node and merges client-side.
+
+TPU-native redesign: gRPC remains the control plane (catalog, params, run
+lifecycle, logs) and a row/JSON event path for display; the *aggregation*
+path ships fixed-size sketch summaries (or nothing at all when nodes share
+a TPU slice — then the merge is a psum over ICI, parallel/cluster.py, and
+the agent only coordinates epochs).
+"""
+
+from .stream import GadgetStream
+from .service import AgentServer, serve
+from .client import AgentClient
+
+__all__ = ["GadgetStream", "AgentServer", "serve", "AgentClient"]

@@ -1,0 +1,87 @@
+"""Wire format for the agent's gRPC streams.
+
+Reference contract: GadgetEvent{type, seq, payload} with log severity
+encoded in the high bits of type (gadgettracermanager/api proto:114-119;
+decode grpc-runtime.go:326-328); params travel as a flat string map
+(service.go:112-131). Messages here are JSON headers with optional binary
+numpy payloads — schema-stable, dependency-light, and the gRPC methods use
+identity (de)serializers so the transport stays grpc-framed bytes. An
+ig.proto documenting the service shapes lives alongside for protoc users.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any
+
+import numpy as np
+
+# event types (ref: api consts; log severity rides the high bits)
+EV_PAYLOAD_JSON = 1     # one event row as JSON
+EV_PAYLOAD_ARRAY = 2    # array-of-rows JSON (interval gadgets)
+EV_RESULT = 3           # final result bytes (RunWithResult)
+EV_BATCH_NPZ = 4        # columnar EventBatch as npz
+EV_SUMMARY = 5          # sketch summary (mergeable state digest)
+EV_CONTROL_ACK = 6
+EV_LOG_SHIFT = 16       # type >> 16 = severity when nonzero
+
+
+def encode_msg(header: dict, payload: bytes = b"") -> bytes:
+    h = json.dumps(header, separators=(",", ":")).encode()
+    return len(h).to_bytes(4, "big") + h + payload
+
+
+def decode_msg(data: bytes) -> tuple[dict, bytes]:
+    n = int.from_bytes(data[:4], "big")
+    header = json.loads(data[4:4 + n])
+    return header, data[4 + n:]
+
+
+def encode_batch(batch) -> bytes:
+    buf = io.BytesIO()
+    arrays = dict(batch.cols)
+    if batch.comm is not None:
+        arrays["__comm__"] = batch.comm
+    np.savez(buf, **{k: v[: batch.count] if v.ndim == 1 else v[: batch.count]
+                     for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def decode_batch(payload: bytes):
+    from ..sources.batch import EventBatch
+
+    with np.load(io.BytesIO(payload)) as z:
+        cols = {k: z[k] for k in z.files if k != "__comm__"}
+        comm = z["__comm__"] if "__comm__" in z.files else None
+    n = len(next(iter(cols.values()))) if cols else 0
+    return EventBatch(cols=cols, count=n, comm=comm)
+
+
+def encode_summary(summary) -> tuple[dict, bytes]:
+    """SketchSummary → (header, payload)."""
+    header = {
+        "events": summary.events, "drops": summary.drops,
+        "distinct": summary.distinct, "entropy": summary.entropy_bits,
+        "epoch": summary.epoch,
+        "anomaly": summary.anomaly,
+    }
+    arr = np.asarray(summary.heavy_hitters, dtype=np.int64)
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return header, buf.getvalue()
+
+
+def decode_summary(header: dict, payload: bytes) -> dict:
+    hh = np.load(io.BytesIO(payload)) if payload else np.zeros((0, 2), np.int64)
+    out = dict(header)
+    out["heavy_hitters"] = [(int(k), int(c)) for k, c in hh]
+    return out
+
+
+def identity_serializer(b: bytes) -> bytes:
+    return b
+
+
+def identity_deserializer(b: bytes) -> bytes:
+    return b

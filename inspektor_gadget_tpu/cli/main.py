@@ -46,10 +46,15 @@ def build_parser() -> argparse.ArgumentParser:
             _add_common_flags(gp)
             for p in desc.params().to_params():
                 d = p.desc
-                gp.add_argument(
-                    f"--{d.key}", default=d.default, dest=f"param_{d.key}",
-                    help=d.description or d.key,
-                )
+                try:
+                    gp.add_argument(
+                        f"--{d.key}", default=d.default, dest=f"param_{d.key}",
+                        help=d.description or d.key,
+                    )
+                except argparse.ArgumentError:
+                    # a common flag (e.g. --max-rows, --sort) owns the option;
+                    # its value is copied into the gadget param in cmd_run
+                    pass
             for op in op_registry.get_all():
                 if not op.can_operate_on(desc):
                     continue
@@ -92,8 +97,11 @@ def cmd_catalog(args) -> int:
 def cmd_run(args) -> int:
     desc = args.desc
     gadget_params = desc.params().to_params()
+    common = {"max-rows": str(args.max_rows), "sort": args.sort or None}
     for p in list(gadget_params):
         v = getattr(args, f"param_{p.key}", None)
+        if v is None and p.key in common:
+            v = common[p.key]
         if v is not None:
             try:
                 gadget_params.set(p.key, v)
@@ -127,6 +135,10 @@ def cmd_run(args) -> int:
 
     cols = ctx.columns
     filters = parse_filters(args.filter, cols) if args.filter and cols else []
+    if cols is not None:
+        from ..environment import Environment, current
+        if current() == Environment.LOCAL:
+            cols.hide_tagged(["kubernetes"])
     if args.columns and cols:
         cols.set_visible(args.columns.split(","))
     formatter = TextFormatter(cols) if cols else None

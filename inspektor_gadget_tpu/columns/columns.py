@@ -267,6 +267,14 @@ class Columns:
         cols = self.visible() if visible_only else self.all()
         return [c.name for c in cols]
 
+    def hide_tagged(self, tags: Sequence[str]) -> None:
+        """Hide columns carrying any of `tags` (ref: pkg/environment-driven
+        visibility of kubernetes columns in local mode)."""
+        tagset = set(tags)
+        for c in self._columns.values():
+            if tagset & set(c.tags):
+                c.visible = False
+
     def set_visible(self, names: Sequence[str]) -> None:
         """Show exactly `names`, in that order (ref: -o columns=... handling
         in pkg/columns/formatter/textcolumns/textcolumns.go)."""

@@ -1,0 +1,148 @@
+"""advise/network-policy — record flows, synthesize Kubernetes
+NetworkPolicies.
+
+Reference: pkg/gadgets/advise/network-policy/advisor.go (417 LoC pure Go:
+GeneratePolicies :277 groups trace/network events by local pod, derives
+ingress/egress rules from peer pod/namespace/CIDR; FormatPolicies :374
+renders YAML). Same synthesis logic here over the trace/network event
+stream; YAML rendered without external deps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import defaultdict
+
+from ...params import ParamDescs
+from ..interface import GadgetDesc, GadgetType
+from ..registry import register
+from ..source_gadget import SourceTraceGadget, source_params
+from ...sources import bridge as B
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowKey:
+    namespace: str
+    pod_selector: str      # e.g. "app=web"
+    egress: bool
+    peer_ns: str
+    peer_selector: str
+    port: int
+    proto: str
+
+
+def _yaml_policy(ns: str, name: str, pod_selector: str,
+                 ingress: list[dict], egress: list[dict]) -> str:
+    """Minimal deterministic YAML renderer for NetworkPolicy objects."""
+    def sel(s: str, indent: str) -> str:
+        if not s:
+            return f"{indent}{{}}\n"
+        k, _, v = s.partition("=")
+        return f"{indent}matchLabels:\n{indent}  {k}: {v}\n"
+
+    out = [
+        "apiVersion: networking.k8s.io/v1",
+        "kind: NetworkPolicy",
+        "metadata:",
+        f"  name: {name}",
+        f"  namespace: {ns}",
+        "spec:",
+        "  podSelector:",
+    ]
+    out.append(sel(pod_selector, "    ").rstrip("\n"))
+    types = []
+    if ingress:
+        types.append("Ingress")
+    if egress:
+        types.append("Egress")
+    out.append("  policyTypes:")
+    for t in types:
+        out.append(f"  - {t}")
+    for kind, rules in (("ingress", ingress), ("egress", egress)):
+        if not rules:
+            continue
+        out.append(f"  {kind}:")
+        for r in rules:
+            peer_key = "from" if kind == "ingress" else "to"
+            out.append(f"  - {peer_key}:")
+            out.append("    - podSelector:")
+            out.append(sel(r["peer_selector"], "        ").rstrip("\n"))
+            if r.get("peer_ns"):
+                out.append("      namespaceSelector:")
+                out.append(f"        matchLabels:\n          kubernetes.io/metadata.name: {r['peer_ns']}")
+            out.append("    ports:")
+            out.append(f"    - protocol: {r['proto'].upper()}")
+            out.append(f"      port: {r['port']}")
+    return "\n".join(out) + "\n"
+
+
+def generate_policies(flows: list[dict]) -> str:
+    """flows: [{namespace, pod, egress: bool, peer_ns, peer_pod, port,
+    proto}] → concatenated YAML documents (ref: GeneratePolicies :277)."""
+    grouped: dict[tuple[str, str], dict[str, list[dict]]] = defaultdict(
+        lambda: {"ingress": [], "egress": []})
+    seen: set[tuple] = set()
+    for f in flows:
+        key = (f["namespace"], f.get("pod_selector") or f.get("pod", ""))
+        rule = {
+            "peer_selector": f.get("peer_selector", ""),
+            "peer_ns": f.get("peer_ns", ""),
+            "port": f["port"],
+            "proto": f.get("proto", "tcp"),
+        }
+        dedup = (key, f["egress"], tuple(sorted(rule.items())))
+        if dedup in seen:
+            continue
+        seen.add(dedup)
+        grouped[key]["egress" if f["egress"] else "ingress"].append(rule)
+    docs = []
+    for (ns, selector), rules in sorted(grouped.items()):
+        name = f"{(selector or 'all').replace('=', '-')}-network"
+        docs.append(_yaml_policy(ns or "default", name, selector,
+                                 rules["ingress"], rules["egress"]))
+    return "---\n".join(docs)
+
+
+class AdviseNetworkPolicy(SourceTraceGadget):
+    native_kind = None
+    synth_kind = B.SRC_SYNTH_TCP
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._flows: list[dict] = []
+
+    def process_batch(self, batch) -> None:
+        c = batch.cols
+        for i in range(batch.count):
+            aux2 = int(c["aux2"][i])
+            mntns = int(c["mntns"][i])
+            self._flows.append({
+                "namespace": "default",
+                "pod_selector": f"app=workload-{mntns % 8}",
+                "egress": bool(aux2 & 1),
+                "peer_selector": f"app=peer-{int(c['aux1'][i]) % 4}",
+                "peer_ns": "",
+                "port": aux2 & 0xFFFF or 80,
+                "proto": "tcp",
+            })
+
+    def run_with_result(self, ctx) -> bytes:
+        self.run(ctx)
+        ctx.result = generate_policies(self._flows)
+        return ctx.result.encode()
+
+
+@register
+class AdviseNetworkPolicyDesc(GadgetDesc):
+    name = "network-policy"
+    category = "advise"
+    gadget_type = GadgetType.PROFILE
+    description = "Record flows and generate NetworkPolicies"
+    event_cls = None
+
+    def params(self) -> ParamDescs:
+        return source_params()
+
+    def new_instance(self, ctx) -> AdviseNetworkPolicy:
+        return AdviseNetworkPolicy(ctx)

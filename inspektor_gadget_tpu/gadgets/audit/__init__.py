@@ -1,0 +1,1 @@
+"""audit/* gadgets (ref: pkg/gadgets/audit)."""

@@ -1,0 +1,62 @@
+"""audit/seccomp — seccomp violation events.
+
+Reference: pkg/gadgets/audit/seccomp (audit-seccomp.bpf.c kprobe on
+audit_seccomp; reports pid/comm/syscall/code e.g. SECCOMP_RET_KILL).
+Without a kprobe window this runs on the synthetic syscall stream; the
+schema, the code decoding, and container filtering match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ...columns import col
+from ...params import ParamDescs
+from ...types import Event, WithMountNsID
+from ..interface import GadgetDesc, GadgetType
+from ..registry import register
+from ..source_gadget import SourceTraceGadget, source_params
+from ...sources import bridge as B
+from ...utils.syscalls import syscall_name
+
+_CODES = {0: "KILL_THREAD", 1: "KILL_PROCESS", 2: "TRAP", 3: "ERRNO",
+          4: "USER_NOTIF", 5: "TRACE", 6: "LOG"}
+
+
+@dataclasses.dataclass
+class SeccompEvent(Event, WithMountNsID):
+    pid: int = col(0, template="pid", dtype=np.int32)
+    comm: str = col("", template="comm")
+    syscall: str = col("", template="syscall")
+    code: str = col("", width=13)
+
+
+class AuditSeccomp(SourceTraceGadget):
+    native_kind = None
+    synth_kind = B.SRC_SYNTH_EXEC
+
+    def decode_row(self, batch, i):
+        c = batch.cols
+        return SeccompEvent(
+            timestamp=int(c["ts"][i]), mountnsid=int(c["mntns"][i]),
+            pid=int(c["pid"][i]), comm=batch.comm_str(i),
+            syscall=syscall_name(int(c["aux2"][i]) % 335),
+            code=_CODES.get(int(c["aux1"][i]) % 7, "LOG"),
+        )
+
+
+@register
+class AuditSeccompDesc(GadgetDesc):
+    name = "seccomp"
+    category = "audit"
+    gadget_type = GadgetType.TRACE
+    description = "Audit seccomp filter actions"
+    event_cls = SeccompEvent
+
+    def params(self) -> ParamDescs:
+        return source_params()
+
+    def new_instance(self, ctx) -> AuditSeccomp:
+        return AuditSeccomp(ctx)

@@ -1,0 +1,78 @@
+"""profile/block-io — block I/O latency histogram.
+
+Reference: pkg/gadgets/profile/block-io (biolatency.bpf.c log2 latency
+histogram accumulated in a BPF map on rq issue→complete; RunWithResult
+renders an ASCII histogram). Native analogue: sample /proc/diskstats at
+high frequency; each window's completed-IO count and queue-time delta give
+a per-window average latency observation weighted by IO count, folded into
+the same log2-bucket ASCII histogram (usecs buckets).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ...params import ParamDescs
+from ..interface import GadgetDesc, GadgetType
+from ..registry import register
+from ..top.block_io import _read_diskstats
+
+
+def render_log2_hist(buckets: list[int], unit: str = "usecs") -> bytes:
+    """ASCII histogram in the reference's (BCC) style."""
+    out = [f"     {unit:<12}: count    distribution"]
+    maxv = max(buckets) if buckets else 0
+    for i, n in enumerate(buckets):
+        if maxv == 0:
+            break
+        lo, hi = (0 if i == 0 else 1 << (i - 1)), (1 << i) - 1
+        bar = "*" * int(40 * n / maxv) if maxv else ""
+        out.append(f"{lo:>10} -> {hi:<10}: {n:<8} |{bar:<40}|")
+    # trim empty tail
+    while len(out) > 1 and out[-1].split("|")[1].strip() == "":
+        tail_count = int(out[-1].split(":")[1].split("|")[0])
+        if tail_count:
+            break
+        out.pop()
+    return ("\n".join(out) + "\n").encode()
+
+
+class ProfileBlockIo:
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def run_with_result(self, ctx) -> bytes:
+        buckets = [0] * 32
+        prev = _read_diskstats()
+        while not ctx.done:
+            if ctx.sleep_or_done(0.05):
+                break
+            cur = _read_diskstats()
+            for dev, now in cur.items():
+                p = prev.get(dev)
+                if p is None:
+                    continue
+                dios = (now[0] - p[0]) + (now[2] - p[2])
+                dq_ms = now[5] - p[5]
+                if dios > 0 and dq_ms >= 0:
+                    avg_us = max(int(dq_ms * 1000 / dios), 1)
+                    buckets[min(avg_us.bit_length(), 31)] += dios
+            prev = cur
+        return render_log2_hist(buckets)
+
+    run = run_with_result
+
+
+@register
+class ProfileBlockIoDesc(GadgetDesc):
+    name = "block-io"
+    category = "profile"
+    gadget_type = GadgetType.PROFILE
+    description = "Block I/O latency log2 histogram"
+    event_cls = None
+
+    def params(self) -> ParamDescs:
+        return ParamDescs()
+
+    def new_instance(self, ctx) -> ProfileBlockIo:
+        return ProfileBlockIo(ctx)

@@ -1,0 +1,1 @@
+"""snapshot/* gadgets — one-shot state collectors (ref: pkg/gadgets/snapshot)."""

@@ -1,0 +1,118 @@
+"""snapshot/socket — one-shot socket listing.
+
+Reference: pkg/gadgets/snapshot/socket (BPF socket iterators
+tcp4-collector.c/udp4-collector.c). Procfs analogue: parse
+/proc/net/{tcp,tcp6,udp,udp6} — same rows (proto, local, remote, state,
+inode), protocol filter param mirrored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import struct
+
+import numpy as np
+
+from ...columns import col
+from ...params import ParamDesc, ParamDescs
+from ...types import Event, WithNetNsID
+from ..interface import GadgetDesc, GadgetType
+from ..registry import register
+
+_TCP_STATES = {
+    1: "ESTABLISHED", 2: "SYN_SENT", 3: "SYN_RECV", 4: "FIN_WAIT1",
+    5: "FIN_WAIT2", 6: "TIME_WAIT", 7: "CLOSE", 8: "CLOSE_WAIT",
+    9: "LAST_ACK", 10: "LISTEN", 11: "CLOSING",
+}
+
+
+@dataclasses.dataclass
+class SocketEvent(Event, WithNetNsID):
+    protocol: str = col("", width=5)
+    localaddr: str = col("", template="ipaddr")
+    localport: int = col(0, template="ipport", dtype=np.int32)
+    remoteaddr: str = col("", template="ipaddr")
+    remoteport: int = col(0, template="ipport", dtype=np.int32)
+    status: str = col("", width=12)
+    inode: int = col(0, width=10, dtype=np.int64)
+
+
+def _decode_addr4(hexstr: str) -> tuple[str, int]:
+    addr, _, port = hexstr.partition(":")
+    ip = socket.inet_ntoa(struct.pack("<I", int(addr, 16)))
+    return ip, int(port, 16)
+
+
+def _decode_addr6(hexstr: str) -> tuple[str, int]:
+    addr, _, port = hexstr.partition(":")
+    raw = bytes.fromhex(addr)
+    # /proc/net/tcp6 stores 4 LE u32 words
+    words = [raw[i:i + 4][::-1] for i in range(0, 16, 4)]
+    ip = socket.inet_ntop(socket.AF_INET6, b"".join(words))
+    return ip, int(port, 16)
+
+
+def _parse(path: str, proto: str, v6: bool) -> list[SocketEvent]:
+    rows = []
+    try:
+        with open(path) as f:
+            next(f)
+            for line in f:
+                p = line.split()
+                if len(p) < 10:
+                    continue
+                try:
+                    la, lp = (_decode_addr6 if v6 else _decode_addr4)(p[1])
+                    ra, rp = (_decode_addr6 if v6 else _decode_addr4)(p[2])
+                    state = int(p[3], 16)
+                    inode = int(p[9])
+                except (ValueError, OSError):
+                    continue
+                status = _TCP_STATES.get(state, str(state)) if proto == "tcp" else ""
+                rows.append(SocketEvent(protocol=proto, localaddr=la,
+                                        localport=lp, remoteaddr=ra,
+                                        remoteport=rp, status=status,
+                                        inode=inode))
+    except OSError:
+        pass
+    return rows
+
+
+class SnapshotSocket:
+    def __init__(self, ctx):
+        p = ctx.gadget_params
+        self.proto = p.get("proto").as_string() if "proto" in p else "all"
+
+    def run_with_result(self, ctx) -> bytes:
+        rows: list[SocketEvent] = []
+        if self.proto in ("all", "tcp"):
+            rows += _parse("/proc/net/tcp", "tcp", False)
+            rows += _parse("/proc/net/tcp6", "tcp", True)
+        if self.proto in ("all", "udp"):
+            rows += _parse("/proc/net/udp", "udp", False)
+            rows += _parse("/proc/net/udp6", "udp", True)
+        ctx.result = rows
+        from ...columns import TextFormatter
+        return TextFormatter(ctx.columns).format_table(rows).encode()
+
+    def run(self, ctx) -> None:
+        self.run_with_result(ctx)
+
+
+@register
+class SnapshotSocketDesc(GadgetDesc):
+    name = "socket"
+    category = "snapshot"
+    gadget_type = GadgetType.ONE_SHOT
+    description = "List open sockets"
+    event_cls = SocketEvent
+
+    def params(self) -> ParamDescs:
+        return ParamDescs([
+            ParamDesc(key="proto", default="all",
+                      possible_values=("all", "tcp", "udp")),
+        ])
+
+    def new_instance(self, ctx) -> SnapshotSocket:
+        return SnapshotSocket(ctx)

@@ -115,6 +115,8 @@ class SourceTraceGadget:
                         break
                     continue
                 self._apply_filter(batch)
+                if batch.count:
+                    self.process_batch(batch)
                 if batch.count and self._batch_handler is not None:
                     self._batch_handler(batch)
                 if batch.count and self._event_handler is not None:
@@ -146,6 +148,10 @@ class SourceTraceGadget:
         if batch.comm is not None:
             batch.comm[: len(keep)] = batch.comm[keep]
         batch.count = len(keep)
+
+    def process_batch(self, batch: EventBatch) -> None:
+        """Internal hook run on every batch regardless of external handlers
+        (gadgets that accumulate state — advise/traceloop — override this)."""
 
     # display ---------------------------------------------------------------
 

@@ -1,0 +1,1 @@
+"""top/* gadgets — interval heavy-hitter views (ref: pkg/gadgets/top/*)."""

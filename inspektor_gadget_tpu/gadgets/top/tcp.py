@@ -1,0 +1,105 @@
+"""top/tcp — busiest TCP connections per interval.
+
+Reference: pkg/gadgets/top/tcp (tcptop.bpf.c kprobes tcp_sendmsg/
+tcp_cleanup_rbuf summing bytes per connection). Without kernel probes the
+procfs view has no per-connection byte counters, so this gadget runs on the
+event stream: it consumes the trace/tcp source and aggregates
+events-per-connection per interval (connection churn top); with the
+synthetic source, aux1 carries a bytes field and real byte totals appear.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+from ...columns import col
+from ...params import ParamDescs
+from ...types import Event, WithMountNsID
+from ..interface import GadgetDesc, GadgetType
+from ..interval_gadget import IntervalGadget, interval_params
+from ..registry import register
+from ..source_gadget import SourceTraceGadget, source_params
+from ...sources.bridge import SRC_PROC_TCP, SRC_SYNTH_TCP
+
+
+@dataclasses.dataclass
+class TcpTopStats(Event, WithMountNsID):
+    pid: int = col(0, template="pid", dtype=np.int32)
+    comm: str = col("", template="comm")
+    conn: str = col("", width=36)
+    events: int = col(0, width=8, group="sum", dtype=np.int64)
+    bytes: int = col(0, width=12, group="sum", dtype=np.int64)
+
+
+class _TcpFeed(SourceTraceGadget):
+    native_kind = SRC_PROC_TCP
+    synth_kind = SRC_SYNTH_TCP
+
+    def decode_row(self, batch, i):
+        return None  # unused; top consumes batches
+
+
+class TopTcp(IntervalGadget):
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._feed = _TcpFeed(ctx)
+        self._lock = threading.Lock()
+        self._stats: dict[tuple, list] = {}
+        self._thread: threading.Thread | None = None
+
+    def set_mntns_filter(self, mntns_ids) -> None:
+        self._feed.set_mntns_filter(mntns_ids)
+
+    def setup(self, ctx) -> None:
+        self._feed.set_batch_handler(self._on_batch)
+        self._thread = threading.Thread(
+            target=self._feed.run, args=(ctx,), daemon=True)
+        self._thread.start()
+
+    def teardown(self, ctx) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _on_batch(self, batch) -> None:
+        c = batch.cols
+        n = batch.count
+        with self._lock:
+            for i in range(n):
+                key = (int(c["pid"][i]), int(c["key_hash"][i]))
+                ent = self._stats.get(key)
+                if ent is None:
+                    self._stats[key] = ent = [0, 0, batch.comm_str(i),
+                                              int(c["mntns"][i]),
+                                              int(c["key_hash"][i])]
+                ent[0] += 1
+                ent[1] += int(c["aux1"][i]) & 0xFFFF  # synthetic bytes field
+
+    def collect(self, ctx) -> list[TcpTopStats]:
+        with self._lock:
+            stats, self._stats = self._stats, {}
+        rows = []
+        for (pid, _h), (events, nbytes, comm, mntns, key_hash) in stats.items():
+            conn = self._feed.resolve_key(key_hash) or f"0x{key_hash:016x}"
+            rows.append(TcpTopStats(pid=pid, comm=comm, conn=conn,
+                                    events=events, bytes=nbytes, mountnsid=mntns))
+        return rows
+
+
+@register
+class TopTcpDesc(GadgetDesc):
+    name = "tcp"
+    category = "top"
+    gadget_type = GadgetType.TRACE_INTERVALS
+    description = "Top TCP connections per interval"
+    event_cls = TcpTopStats
+
+    def params(self) -> ParamDescs:
+        descs = interval_params("-events,-bytes")
+        descs.extend(source_params())
+        return descs
+
+    def new_instance(self, ctx) -> TopTcp:
+        return TopTcp(ctx)

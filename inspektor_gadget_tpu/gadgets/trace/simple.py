@@ -1,0 +1,246 @@
+"""trace/{open,mount,signal,oomkill,capabilities,bind,fsslower} — the
+syscall-family trace gadgets.
+
+Reference (pkg/gadgets/trace/*): opensnoop.bpf.c (openat tracepoints),
+mountsnoop.bpf.c, sigsnoop.bpf.c, oomkill.bpf.c (kprobe oom_kill_process),
+capable.bpf.c (kprobe cap_capable), bindsnoop.bpf.c, fsslower.bpf.c —
+each ~150-250 LoC BPF + ~200-290 LoC Go tracer. Here each gadget is a
+schema + row decoder over the shared capture pipeline; the synthetic
+source provides deterministic streams for every kind, and the netlink/
+procfs exec source feeds lifecycle-adjacent kinds where the kernel offers
+a non-BPF window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ...columns import col
+from ...params import ParamDesc, ParamDescs, TypeHint
+from ...types import Event, WithMountNsID
+from ..interface import GadgetDesc, GadgetType
+from ..registry import register
+from ..source_gadget import SourceTraceGadget, source_params
+from ...sources import bridge as B
+
+
+@dataclasses.dataclass
+class _Base(Event, WithMountNsID):
+    pid: int = col(0, template="pid", dtype=np.int32)
+    comm: str = col("", template="comm")
+    uid: int = col(0, template="uid", dtype=np.int32)
+
+
+def _base_fields(g, batch, i, cls, **kw):
+    c = batch.cols
+    return cls(
+        timestamp=int(c["ts"][i]), mountnsid=int(c["mntns"][i]),
+        pid=int(c["pid"][i]), uid=int(c["uid"][i]),
+        comm=batch.comm_str(i) or g.resolve_key(int(c["key_hash"][i])), **kw,
+    )
+
+
+def _simple_gadget(gname: str, desc_text: str, event_cls, decode, synth_kind: int,
+                   extra_params: list[ParamDesc] | None = None):
+    """Build + register a capture-backed trace gadget."""
+
+    gadget_cls = type(f"Trace{gname.title()}", (SourceTraceGadget,), {
+        "native_kind": None,
+        "synth_kind": synth_kind,
+        "decode_row": decode,
+    })
+
+    def _params(self) -> ParamDescs:
+        p = source_params()
+        if extra_params:
+            p.extend(extra_params)
+        return p
+
+    Desc = type(f"Trace{gname.title()}Desc", (GadgetDesc,), {
+        "name": gname,
+        "category": "trace",
+        "gadget_type": GadgetType.TRACE,
+        "description": desc_text,
+        "event_cls": event_cls,
+        "params": _params,
+        "new_instance": lambda self, ctx: gadget_cls(ctx),
+    })
+    register(Desc())
+    return Desc
+
+
+# -- trace/open (ref: pkg/gadgets/trace/open, opensnoop.bpf.c 163) ----------
+
+@dataclasses.dataclass
+class OpenEvent(_Base):
+    fd: int = col(0, width=4, dtype=np.int32)
+    ret: int = col(0, width=4, dtype=np.int32)
+    flags: int = col(0, width=8, hide=True, dtype=np.int32)
+    mode: int = col(0, width=6, hide=True, dtype=np.int32)
+    path: str = col("", width=32, ellipsis="start")
+
+
+def _decode_open(self, batch, i):
+    c = batch.cols
+    aux2 = int(c["aux2"][i])
+    return _base_fields(self, batch, i, OpenEvent,
+                        fd=aux2 & 0xFFFF, ret=(aux2 >> 16) & 0xFF,
+                        flags=int(c["aux1"][i]) & 0xFFFFF,
+                        path=self.resolve_key(int(c["key_hash"][i])))
+
+
+_simple_gadget("open", "Trace open() calls", OpenEvent, _decode_open, B.SRC_SYNTH_EXEC)
+
+
+# -- trace/mount (ref: mountsnoop.bpf.c 168) --------------------------------
+
+@dataclasses.dataclass
+class MountEvent(_Base):
+    operation: str = col("", width=7)
+    source: str = col("", width=24)
+    target: str = col("", width=24, hide=True)
+    ret: int = col(0, width=4, dtype=np.int32)
+
+
+def _decode_mount(self, batch, i):
+    c = batch.cols
+    return _base_fields(self, batch, i, MountEvent,
+                        operation="mount" if int(c["aux2"][i]) % 2 == 0 else "umount",
+                        source=self.resolve_key(int(c["key_hash"][i])),
+                        ret=0)
+
+
+_simple_gadget("mount", "Trace mount/umount", MountEvent, _decode_mount,
+               B.SRC_SYNTH_EXEC)
+
+
+# -- trace/signal (ref: sigsnoop.bpf.c 175) ---------------------------------
+
+_SIGNAMES = {1: "SIGHUP", 2: "SIGINT", 9: "SIGKILL", 11: "SIGSEGV",
+             15: "SIGTERM", 17: "SIGCHLD", 13: "SIGPIPE"}
+
+
+@dataclasses.dataclass
+class SignalEvent(_Base):
+    signal: str = col("", width=9)
+    tpid: int = col(0, template="pid", dtype=np.int32)
+    ret: int = col(0, width=4, dtype=np.int32)
+
+
+def _decode_signal(self, batch, i):
+    c = batch.cols
+    sig = int(c["aux2"][i]) % 31 + 1
+    return _base_fields(self, batch, i, SignalEvent,
+                        signal=_SIGNAMES.get(sig, str(sig)),
+                        tpid=int(c["ppid"][i]), ret=0)
+
+
+_simple_gadget("signal", "Trace signal delivery", SignalEvent, _decode_signal,
+               B.SRC_SYNTH_EXEC)
+
+
+# -- trace/oomkill (ref: oomkill.bpf.c 51) ----------------------------------
+
+@dataclasses.dataclass
+class OomKillEvent(_Base):
+    kpid: int = col(0, template="pid", dtype=np.int32)
+    kcomm: str = col("", template="comm")
+    pages: int = col(0, width=8, dtype=np.int64)
+
+
+def _decode_oom(self, batch, i):
+    c = batch.cols
+    return _base_fields(self, batch, i, OomKillEvent,
+                        kpid=int(c["pid"][i]),
+                        kcomm=batch.comm_str(i),
+                        pages=int(c["aux1"][i]) & 0xFFFFF)
+
+
+_simple_gadget("oomkill", "Trace OOM killer", OomKillEvent, _decode_oom,
+               B.SRC_SYNTH_EXEC)
+
+
+# -- trace/capabilities (ref: capable.bpf.c 250) ----------------------------
+
+_CAPS = ["CHOWN", "DAC_OVERRIDE", "DAC_READ_SEARCH", "FOWNER", "FSETID",
+         "KILL", "SETGID", "SETUID", "SETPCAP", "LINUX_IMMUTABLE",
+         "NET_BIND_SERVICE", "NET_BROADCAST", "NET_ADMIN", "NET_RAW",
+         "IPC_LOCK", "IPC_OWNER", "SYS_MODULE", "SYS_RAWIO", "SYS_CHROOT",
+         "SYS_PTRACE", "SYS_PACCT", "SYS_ADMIN", "SYS_BOOT", "SYS_NICE",
+         "SYS_RESOURCE", "SYS_TIME", "SYS_TTY_CONFIG", "MKNOD", "LEASE",
+         "AUDIT_WRITE", "AUDIT_CONTROL", "SETFCAP", "MAC_OVERRIDE",
+         "MAC_ADMIN", "SYSLOG", "WAKE_ALARM", "BLOCK_SUSPEND", "AUDIT_READ",
+         "PERFMON", "BPF", "CHECKPOINT_RESTORE"]
+
+
+@dataclasses.dataclass
+class CapabilityEvent(_Base):
+    cap: str = col("", width=18)
+    audit: bool = col(True, width=5, dtype=np.bool_)
+    verdict: str = col("", width=7)
+
+
+def _decode_cap(self, batch, i):
+    c = batch.cols
+    capid = int(c["aux2"][i]) % len(_CAPS)
+    return _base_fields(self, batch, i, CapabilityEvent,
+                        cap=_CAPS[capid], audit=True,
+                        verdict="allow" if int(c["aux1"][i]) % 4 else "deny")
+
+
+_simple_gadget("capabilities", "Trace capability checks", CapabilityEvent,
+               _decode_cap, B.SRC_SYNTH_EXEC,
+               [ParamDesc(key="audit-only", default="true",
+                          type_hint=TypeHint.BOOL)])
+
+
+# -- trace/bind (ref: bindsnoop.bpf.c 152) ----------------------------------
+
+@dataclasses.dataclass
+class BindEvent(_Base):
+    protocol: str = col("", width=5)
+    addr: str = col("", template="ipaddr")
+    port: int = col(0, template="ipport", dtype=np.int32)
+    interface: str = col("", width=10, hide=True)
+
+
+def _decode_bind(self, batch, i):
+    c = batch.cols
+    aux2 = int(c["aux2"][i])
+    return _base_fields(self, batch, i, BindEvent,
+                        protocol="tcp" if aux2 % 2 == 0 else "udp",
+                        addr="0.0.0.0", port=aux2 & 0xFFFF)
+
+
+_simple_gadget("bind", "Trace bind() calls", BindEvent, _decode_bind,
+               B.SRC_SYNTH_TCP)
+
+
+# -- trace/fsslower (ref: fsslower.bpf.c 239) -------------------------------
+
+@dataclasses.dataclass
+class FsSlowerEvent(_Base):
+    op: str = col("", width=5)
+    bytes: int = col(0, width=10, dtype=np.int64)
+    offset: int = col(0, width=10, hide=True, dtype=np.int64)
+    latency_us: int = col(0, width=10, dtype=np.int64)
+    file: str = col("", width=28, ellipsis="start")
+
+
+def _decode_fsslower(self, batch, i):
+    c = batch.cols
+    ops = ("read", "write", "open", "fsync")
+    return _base_fields(self, batch, i, FsSlowerEvent,
+                        op=ops[int(c["aux2"][i]) % 4],
+                        bytes=int(c["aux1"][i]) & 0xFFFFF,
+                        latency_us=(int(c["aux1"][i]) >> 20) & 0xFFFFF,
+                        file=self.resolve_key(int(c["key_hash"][i])))
+
+
+_simple_gadget("fsslower", "Trace slow filesystem ops", FsSlowerEvent,
+               _decode_fsslower, B.SRC_SYNTH_EXEC,
+               [ParamDesc(key="min-latency", default="10",
+                          type_hint=TypeHint.INT,
+                          description="min latency (ms) to report")])

@@ -1,0 +1,1 @@
+"""traceloop — strace-of-the-past (ref: pkg/gadgets/traceloop)."""

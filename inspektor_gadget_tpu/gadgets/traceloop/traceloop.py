@@ -1,0 +1,128 @@
+"""traceloop — retrospective per-container syscall history.
+
+Reference: pkg/gadgets/traceloop (traceloop.bpf.c:75 `map_of_perf_buffers`
+— one *overwritable* perf ring per container holding recent raw
+sys_enter/sys_exit records; tracer.go Attach:196 creates a ring when a
+container appears, Read:246 drains it retrospectively with syscall-arg
+decode tables). The architecture here is identical one level up: an
+overwrite-oldest deque per container (mntns), fed by the syscall stream;
+`read` renders the recent history with decoded syscall names — history you
+only pay to render when you ask for it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ...columns import Columns, TextFormatter, col
+from ...params import ParamDesc, ParamDescs, TypeHint
+from ...types import Event, WithMountNsID
+from ..interface import Attacher, GadgetDesc, GadgetType
+from ..registry import register
+from ..source_gadget import SourceTraceGadget, source_params
+from ...sources import bridge as B
+from ...utils.syscalls import syscall_name
+
+DEFAULT_RING = 4096  # events kept per container (overwrite-oldest)
+
+
+@dataclasses.dataclass
+class SyscallRecord(Event, WithMountNsID):
+    cpu: int = col(0, width=3, dtype=np.int16)
+    pid: int = col(0, template="pid", dtype=np.int32)
+    comm: str = col("", template="comm")
+    syscall: str = col("", template="syscall")
+    args: str = col("", width=30, hide=True)
+    ret: int = col(0, width=6, dtype=np.int64)
+
+
+class Traceloop(SourceTraceGadget):
+    """Attacher gadget: one overwritable ring per attached container."""
+
+    native_kind = None
+    synth_kind = B.SRC_SYNTH_EXEC
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        p = ctx.gadget_params
+        self.ring_size = p.get("ring-size").as_int() if "ring-size" in p else DEFAULT_RING
+        self._rings: dict[int, deque] = {}
+        self._lock = threading.Lock()
+        self._attach_all = True  # without explicit attaches, ring per seen mntns
+
+    # Attacher protocol (ref: tracer.go Attach:196/Detach) ------------------
+
+    def attach_container(self, container) -> None:
+        with self._lock:
+            self._rings.setdefault(container.mntns, deque(maxlen=self.ring_size))
+            self._attach_all = False
+
+    def detach_container(self, container) -> None:
+        with self._lock:
+            self._rings.pop(container.mntns, None)
+
+    # capture ---------------------------------------------------------------
+
+    def process_batch(self, batch) -> None:
+        c = batch.cols
+        with self._lock:
+            for i in range(batch.count):
+                mntns = int(c["mntns"][i])
+                ring = self._rings.get(mntns)
+                if ring is None:
+                    if not self._attach_all:
+                        continue
+                    ring = self._rings[mntns] = deque(maxlen=self.ring_size)
+                ring.append((
+                    int(c["ts"][i]), int(c["pid"][i]),
+                    batch.comm_str(i), int(c["aux2"][i]) % 335,
+                    int(c["aux1"][i]),
+                ))
+
+    # retrospective read (ref: tracer.go Read:246) --------------------------
+
+    def read(self, mntns: int | None = None) -> list[SyscallRecord]:
+        with self._lock:
+            rings = ({mntns: self._rings[mntns]} if mntns is not None
+                     and mntns in self._rings else dict(self._rings))
+            out = []
+            for ns, ring in rings.items():
+                for ts, pid, comm, nr, aux in ring:
+                    out.append(SyscallRecord(
+                        timestamp=ts, mountnsid=ns, pid=pid, comm=comm,
+                        syscall=syscall_name(nr),
+                        args=f"0x{aux:x}", ret=int(aux) & 0xFF,
+                    ))
+        out.sort(key=lambda r: r.timestamp)
+        return out
+
+    def run_with_result(self, ctx) -> bytes:
+        self.run(ctx)  # record until timeout/stop
+        records = self.read()
+        cols = Columns(SyscallRecord)
+        cols.hide_tagged(["kubernetes"])
+        return TextFormatter(cols).format_table(records[-200:]).encode()
+
+
+@register
+class TraceloopDesc(GadgetDesc):
+    name = "traceloop"
+    category = "traceloop"
+    gadget_type = GadgetType.PROFILE
+    description = "Record recent syscalls per container, read retrospectively"
+    event_cls = SyscallRecord
+
+    def params(self) -> ParamDescs:
+        p = source_params()
+        p.append(ParamDesc(key="ring-size", default=str(DEFAULT_RING),
+                           type_hint=TypeHint.INT,
+                           description="events kept per container"))
+        return p
+
+    def new_instance(self, ctx) -> Traceloop:
+        return Traceloop(ctx)

@@ -124,6 +124,10 @@ class TpuSketchInstance(OperatorInstance):
         self.on_summary: Callable[[SketchSummary], None] | None = ctx.extra.get(
             "on_sketch_summary")
         self._pad = 8192  # fixed device batch shape (pad/mask)
+        # self-observability feed for top/sketch (top/ebpf analogue)
+        from ..gadgets.top.sketch import SketchStatsSource
+        self._stats = SketchStatsSource(ctx.run_id, ctx.desc.full_name)
+        self._stats.register()
 
     # the columnar hot path -------------------------------------------------
 
@@ -157,6 +161,9 @@ class TpuSketchInstance(OperatorInstance):
             jnp.asarray(dist), jnp.asarray(mask),
             jnp.float32(max(new_drops, 0)),
         )
+        self._stats.steps += 1
+        self._stats.events += n
+        self._stats.drops = batch.drops
         if self.anomaly_on:
             self._accumulate_container_dists(batch, n)
         now = time.monotonic()
@@ -208,6 +215,7 @@ class TpuSketchInstance(OperatorInstance):
     def post_gadget_run(self) -> None:
         if self.enabled:
             self.harvest()
+            self._stats.unregister()
 
     # display helpers -------------------------------------------------------
 

@@ -28,12 +28,13 @@ class EventType(str, enum.Enum):
 
 @dataclasses.dataclass
 class CommonData:
-    """Node/workload identity (ref: types.go:73-110)."""
+    """Node/workload identity (ref: types.go:73-110). The kubernetes tag
+    hides these columns in local mode (ref: pkg/environment + column tags)."""
 
-    node: str = col("", template="node")
-    namespace: str = col("", template="namespace")
-    pod: str = col("", template="pod")
-    container: str = col("", template="container")
+    node: str = col("", template="node", tags=("kubernetes",))
+    namespace: str = col("", template="namespace", tags=("kubernetes",))
+    pod: str = col("", template="pod", tags=("kubernetes",))
+    container: str = col("", template="container", tags=("runtime",))
     host_network: bool = col(False, hide=True, dtype=np.bool_)
 
 

@@ -1,0 +1,147 @@
+"""Per-gadget behavior tests (model: the reference's gadget unit tests +
+integration matchers, SURVEY §4)."""
+
+import json
+
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.gadgets import GadgetContext, get, get_all
+from inspektor_gadget_tpu.runtime import LocalRuntime
+
+
+def run_gadget(category, name, timeout=0.6, param_overrides=None,
+               collect_events=False, collect_arrays=False):
+    desc = get(category, name)
+    params = desc.params().to_params()
+    if "source" in params:
+        params.set("source", "pysynthetic")
+        params.set("rate", "50000")
+    for k, v in (param_overrides or {}).items():
+        params.set(k, v)
+    ctx = GadgetContext(desc, gadget_params=params, timeout=timeout)
+    events, arrays = [], []
+    result = LocalRuntime().run_gadget(
+        ctx,
+        on_event=events.append if collect_events else None,
+        on_event_array=arrays.append if collect_arrays else None,
+    )
+    assert not result.errors(), result.errors()
+    return result.first(), events, arrays
+
+
+def test_all_expected_gadgets_registered():
+    have = {(d.category, d.name) for d in get_all()}
+    want = {
+        ("trace", "exec"), ("trace", "open"), ("trace", "tcp"),
+        ("trace", "tcpconnect"), ("trace", "bind"), ("trace", "dns"),
+        ("trace", "sni"), ("trace", "network"), ("trace", "mount"),
+        ("trace", "signal"), ("trace", "oomkill"), ("trace", "capabilities"),
+        ("trace", "fsslower"),
+        ("top", "file"), ("top", "tcp"), ("top", "block-io"), ("top", "sketch"),
+        ("snapshot", "process"), ("snapshot", "socket"),
+        ("profile", "cpu"), ("profile", "block-io"),
+        ("audit", "seccomp"),
+        ("advise", "seccomp-profile"), ("advise", "network-policy"),
+        ("traceloop", "traceloop"),
+    }
+    missing = want - have
+    assert not missing, f"missing gadgets: {missing}"
+
+
+@pytest.mark.parametrize("name", ["open", "mount", "signal", "oomkill",
+                                  "capabilities", "bind", "fsslower", "dns",
+                                  "sni", "network"])
+def test_trace_gadgets_stream_events(name):
+    _, events, _ = run_gadget("trace", name, collect_events=True)
+    assert len(events) > 10
+    ev = events[0]
+    assert ev.timestamp > 0
+
+
+def test_audit_seccomp_decodes_syscalls():
+    _, events, _ = run_gadget("audit", "seccomp", collect_events=True)
+    assert events
+    assert all(e.code in {"KILL_THREAD", "KILL_PROCESS", "TRAP", "ERRNO",
+                          "USER_NOTIF", "TRACE", "LOG"} for e in events[:20])
+
+
+def test_snapshot_process_lists_self():
+    import os
+    result, _, _ = run_gadget("snapshot", "process")
+    # ctx.result carries the row list; bytes result is the rendered table
+    assert result and b"COMM" in result
+    assert str(os.getpid()).encode() in result or b"python" in result
+
+
+def test_snapshot_socket_parses_procnet():
+    result, _, _ = run_gadget("snapshot", "socket")
+    assert result and b"PROTOCOL" in result
+
+
+def test_top_file_emits_arrays():
+    _, _, arrays = run_gadget("top", "file", timeout=2.5,
+                              param_overrides={"interval": "1s"},
+                              collect_arrays=True)
+    assert arrays  # at least one tick (rows may be empty on idle systems)
+
+
+def test_profile_blockio_histogram_renders():
+    result, _, _ = run_gadget("profile", "block-io", timeout=0.8)
+    assert b"usecs" in result and b"distribution" in result
+
+
+def test_profile_cpu_columns_and_folded():
+    result, _, _ = run_gadget("profile", "cpu", timeout=0.7)
+    assert b"SAMPLES" in result
+    folded, _, _ = run_gadget("profile", "cpu", timeout=0.7,
+                              param_overrides={"profile-output": "folded"})
+    # folded lines end with a count
+    line = folded.decode().strip().splitlines()[0]
+    assert line.rsplit(" ", 1)[1].isdigit()
+
+
+def test_advise_seccomp_profile_generates_oci_json():
+    result, _, _ = run_gadget("advise", "seccomp-profile", timeout=0.8)
+    profiles = json.loads(result)
+    assert profiles
+    prof = next(iter(profiles.values()))
+    assert prof["defaultAction"] == "SCMP_ACT_ERRNO"
+    names = prof["syscalls"][0]["names"]
+    assert "execve" in names and prof["syscalls"][0]["action"] == "SCMP_ACT_ALLOW"
+
+
+def test_advise_network_policy_generates_yaml():
+    result, _, _ = run_gadget("advise", "network-policy", timeout=0.8)
+    text = result.decode()
+    assert "kind: NetworkPolicy" in text
+    assert "policyTypes:" in text
+    assert "port:" in text
+
+
+def test_traceloop_retrospective_read():
+    result, _, _ = run_gadget("traceloop", "traceloop", timeout=0.8)
+    text = result.decode()
+    assert "SYSCALL" in text
+    assert len(text.splitlines()) > 5
+
+
+def test_traceloop_ring_overwrites_oldest():
+    from inspektor_gadget_tpu.gadgets.traceloop.traceloop import Traceloop
+    desc = get("traceloop", "traceloop")
+    params = desc.params().to_params()
+    params.set("source", "pysynthetic")
+    params.set("ring-size", "16")
+    ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
+    g = desc.new_instance(ctx)
+    import numpy as np
+    from inspektor_gadget_tpu.sources import EventBatch
+    b = EventBatch.alloc(100)
+    b.cols["mntns"][:] = 42
+    b.cols["ts"][:] = np.arange(100)
+    b.cols["aux2"][:] = np.arange(100)
+    b.count = 100
+    g.process_batch(b)
+    records = g.read(42)
+    assert len(records) == 16  # overwrote the oldest 84
+    assert records[-1].timestamp == 99
