@@ -1,0 +1,175 @@
+"""AgentClient: one node's gRPC connection.
+
+Reference contract: pkg/runtime/grpc — dial (k8s-exec tunnel there, plain
+grpc target here), GetCatalog with client-side cache fallback
+(grpc-runtime.go:62-91), RunGadget stream with seq-gap detection
+(:312-314) and a stop request + bounded result wait (:336-353).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from pathlib import Path
+from typing import Any, Callable, Iterator
+
+import grpc
+
+from . import wire
+
+CONNECT_TIMEOUT = 30.0      # ref: grpc-runtime.go:45-52
+RESULT_TIMEOUT = 30.0
+
+CATALOG_CACHE = Path.home() / ".ig-tpu" / "catalog.json"
+
+
+class AgentClient:
+    def __init__(self, target: str, node_name: str = ""):
+        self.target = target
+        self.node_name = node_name or target
+        self.channel = grpc.insecure_channel(target)
+
+    def close(self) -> None:
+        self.channel.close()
+
+    # -- catalog ------------------------------------------------------------
+
+    def get_catalog(self, use_cache_on_error: bool = True) -> dict:
+        method = self.channel.unary_unary(
+            "/igtpu.GadgetManager/GetCatalog",
+            request_serializer=wire.identity_serializer,
+            response_deserializer=wire.identity_deserializer,
+        )
+        try:
+            reply = method(wire.encode_msg({}), timeout=CONNECT_TIMEOUT)
+            header, _ = wire.decode_msg(reply)
+            catalog = header["catalog"]
+            try:  # cache for offline flag rendering (ref: catalog cache)
+                CATALOG_CACHE.parent.mkdir(parents=True, exist_ok=True)
+                CATALOG_CACHE.write_text(json.dumps(catalog))
+            except OSError:
+                pass
+            return catalog
+        except grpc.RpcError:
+            if use_cache_on_error and CATALOG_CACHE.exists():
+                return json.loads(CATALOG_CACHE.read_text())
+            raise
+
+    # -- run ----------------------------------------------------------------
+
+    def run_gadget(
+        self,
+        category: str,
+        name: str,
+        params: dict[str, str] | None = None,
+        *,
+        timeout: float = 0.0,
+        outputs: tuple[str, ...] = ("json",),
+        on_json: Callable[[str, dict], None] | None = None,
+        on_array: Callable[[str, list], None] | None = None,
+        on_batch: Callable[[str, Any], None] | None = None,
+        on_summary: Callable[[str, dict], None] | None = None,
+        on_log: Callable[[str, int, str], None] | None = None,
+        stop_event: threading.Event | None = None,
+    ) -> dict:
+        """Blocking run; returns {'result': bytes|None, 'error': str|None,
+        'gaps': int, 'dropped': int}."""
+        method = self.channel.stream_stream(
+            "/igtpu.GadgetManager/RunGadget",
+            request_serializer=wire.identity_serializer,
+            response_deserializer=wire.identity_deserializer,
+        )
+        ctrl_q: queue.Queue = queue.Queue()
+        ctrl_q.put(wire.encode_msg({"run": {
+            "category": category, "name": name, "params": params or {},
+            "timeout": timeout, "output": list(outputs),
+        }}))
+
+        def requests() -> Iterator[bytes]:
+            while True:
+                item = ctrl_q.get()
+                if item is None:
+                    return
+                yield item
+
+        if stop_event is not None:
+            def stopper():
+                stop_event.wait()
+                ctrl_q.put(wire.encode_msg({"stop": True}))
+                ctrl_q.put(None)
+            threading.Thread(target=stopper, daemon=True).start()
+
+        out = {"result": None, "error": None, "gaps": 0, "dropped": 0}
+        last_seq = 0
+        call = method(requests(), timeout=None if timeout == 0 else timeout + RESULT_TIMEOUT)
+        try:
+            for msg in call:
+                header, payload = wire.decode_msg(msg)
+                seq = header.get("seq", 0)
+                if seq and last_seq and seq != last_seq + 1:
+                    out["gaps"] += seq - last_seq - 1  # ref: seq-gap :312-314
+                if seq:
+                    last_seq = seq
+                t = header.get("type", 0)
+                sev = t >> wire.EV_LOG_SHIFT
+                if sev:
+                    if on_log:
+                        on_log(self.node_name, sev, payload.decode("utf-8", "replace"))
+                elif t == wire.EV_PAYLOAD_JSON:
+                    if on_json:
+                        on_json(self.node_name, json.loads(payload))
+                elif t == wire.EV_PAYLOAD_ARRAY:
+                    if on_array:
+                        on_array(self.node_name, json.loads(payload))
+                elif t == wire.EV_BATCH_NPZ:
+                    if on_batch:
+                        on_batch(self.node_name, wire.decode_batch(payload))
+                elif t == wire.EV_SUMMARY:
+                    if on_summary:
+                        on_summary(self.node_name, wire.decode_summary(header, payload))
+                elif t == wire.EV_RESULT:
+                    out["error"] = header.get("error")
+                    out["result"] = payload or None
+                elif t == wire.EV_CONTROL_ACK:
+                    out["dropped"] = header.get("dropped", 0)
+                elif "error" in header:
+                    out["error"] = header["error"]
+        except grpc.RpcError as e:
+            if e.code() != grpc.StatusCode.CANCELLED:
+                out["error"] = f"{e.code().name}: {e.details()}"
+        finally:
+            ctrl_q.put(None)
+        return out
+
+    # -- container hooks (ref: hooks/oci/main.go) ---------------------------
+
+    def add_container(self, container: dict) -> dict:
+        method = self.channel.unary_unary(
+            "/igtpu.GadgetManager/AddContainer",
+            request_serializer=wire.identity_serializer,
+            response_deserializer=wire.identity_deserializer,
+        )
+        h, _ = wire.decode_msg(method(wire.encode_msg({"container": container}),
+                                      timeout=CONNECT_TIMEOUT))
+        return h
+
+    def remove_container(self, container_id: str) -> dict:
+        method = self.channel.unary_unary(
+            "/igtpu.GadgetManager/RemoveContainer",
+            request_serializer=wire.identity_serializer,
+            response_deserializer=wire.identity_deserializer,
+        )
+        h, _ = wire.decode_msg(method(
+            wire.encode_msg({"container": {"id": container_id}}),
+            timeout=CONNECT_TIMEOUT))
+        return h
+
+    def dump_state(self) -> dict:
+        method = self.channel.unary_unary(
+            "/igtpu.GadgetManager/DumpState",
+            request_serializer=wire.identity_serializer,
+            response_deserializer=wire.identity_deserializer,
+        )
+        h, _ = wire.decode_msg(method(wire.encode_msg({}), timeout=CONNECT_TIMEOUT))
+        return h

@@ -1,0 +1,90 @@
+"""ig-tpu-agent daemon + hook client subcommands.
+
+Reference contract: gadget-container/gadgettracermanager/main.go — serve
+mode starts the gRPC services on a unix socket (:247-299) with a liveness
+probe subcommand (:224-245); the same binary doubles as the hook client
+(add/remove-container, used by OCI/NRI hooks — hooks/oci/main.go).
+
+Usage:
+  python -m inspektor_gadget_tpu.agent.main serve --listen unix:///run/ig.sock
+  python -m inspektor_gadget_tpu.agent.main liveness --target ...
+  python -m inspektor_gadget_tpu.agent.main add-container --id c1 --pid 123 ...
+  python -m inspektor_gadget_tpu.agent.main dump   # debug state (DumpState)
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ig-tpu-agent")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("serve")
+    sp.add_argument("--listen", default="unix:///tmp/igtpu-agent.sock")
+    sp.add_argument("--node-name", default="node")
+
+    for name in ("liveness", "dump"):
+        p = sub.add_parser(name)
+        p.add_argument("--target", default="unix:///tmp/igtpu-agent.sock")
+
+    acp = sub.add_parser("add-container")
+    acp.add_argument("--target", default="unix:///tmp/igtpu-agent.sock")
+    for f in ("id", "name", "namespace", "pod"):
+        acp.add_argument(f"--{f}", default="")
+    acp.add_argument("--pid", type=int, default=0)
+    acp.add_argument("--mntns", type=int, default=0)
+
+    rcp = sub.add_parser("remove-container")
+    rcp.add_argument("--target", default="unix:///tmp/igtpu-agent.sock")
+    rcp.add_argument("--id", required=True)
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "serve":
+        from .service import serve
+        server, _agent = serve(args.listen, node_name=args.node_name)
+        print(f"ig-tpu-agent listening on {args.listen}", flush=True)
+        stop = [False]
+
+        def on_sig(*_):
+            stop[0] = True
+        signal.signal(signal.SIGTERM, on_sig)
+        signal.signal(signal.SIGINT, on_sig)
+        while not stop[0]:
+            time.sleep(0.2)
+        server.stop(grace=2.0)
+        return 0
+
+    from .client import AgentClient
+    client = AgentClient(args.target)
+    if args.cmd == "liveness":
+        try:
+            client.get_catalog(use_cache_on_error=False)
+            print("ok")
+            return 0
+        except Exception as e:
+            print(f"unhealthy: {e}", file=sys.stderr)
+            return 1
+    if args.cmd == "dump":
+        import json
+        print(json.dumps(client.dump_state(), indent=2))
+        return 0
+    if args.cmd == "add-container":
+        print(client.add_container({
+            "id": args.id, "name": args.name, "pid": args.pid,
+            "mntns": args.mntns, "namespace": args.namespace, "pod": args.pod,
+        }))
+        return 0
+    if args.cmd == "remove-container":
+        print(client.remove_container(args.id))
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Agent server: the per-node gRPC service.
+
+Reference contract (pkg/gadget-service/service.go): RunGadget :78-249 —
+parse the run request, split the flat params map by prefix, build a
+GadgetContext, pump events through a bounded 1024 buffer with drop-on-full
+(:134-168), a sender goroutine forwards to the stream (:170-181), logs ride
+the same stream with severity in the type bits (gadget-service/logger.go);
+plus the container hooks service (gadgettracermanager.go AddContainer:151)
+and a health service (daemon main.go:224-245).
+
+gRPC methods are registered with generic handlers + identity serializers;
+message bodies use wire.py framing.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from concurrent import futures
+from typing import Iterator
+
+import grpc
+
+from .. import all_gadgets  # noqa: F401
+from ..containers import Container
+from ..gadgets import GadgetContext
+from ..gadgets import registry as gadget_registry
+from ..gadgets.interface import GadgetType
+from ..operators import operators as op_registry
+from ..params import Collection
+from ..runtime.local import LocalRuntime
+from ..runtime.runtime import build_catalog
+from . import wire
+
+EVENT_BUFFER = 1024  # ref: service.go:134 bounded buffer, drop-on-full
+
+log = logging.getLogger("ig-tpu.agent")
+
+
+class AgentServer:
+    def __init__(self, node_name: str = "node"):
+        self.node_name = node_name
+        self.runtime = LocalRuntime(node_name=node_name)
+        self._runs: dict[str, GadgetContext] = {}
+        self._runs_mu = threading.Lock()
+
+    # -- GadgetManager.GetCatalog ------------------------------------------
+
+    def get_catalog(self, request: bytes, context) -> bytes:
+        catalog = build_catalog()
+        catalog["node"] = self.node_name
+        return wire.encode_msg({"catalog": catalog})
+
+    # -- GadgetManager.RunGadget (bidi stream) ------------------------------
+
+    def run_gadget(self, request_iterator: Iterator[bytes], context) -> Iterator[bytes]:
+        first = next(request_iterator)
+        header, _ = wire.decode_msg(first)
+        run = header.get("run")
+        if not run:
+            yield wire.encode_msg({"error": "first message must be a run request"})
+            return
+
+        try:
+            desc = gadget_registry.get(run["category"], run["name"])
+        except KeyError as e:
+            yield wire.encode_msg({"error": str(e)})
+            return
+
+        flat = run.get("params", {})
+        gadget_params = desc.params().to_params()
+        gadget_params.copy_from_map(flat, "gadget.")
+        op_params = Collection({
+            f"operator.{op.name}.": op.instance_params().to_params()
+            for op in op_registry.get_all() if op.can_operate_on(desc)
+        })
+        op_params.copy_from_map(flat)
+
+        outputs = set(run.get("output") or ["json"])
+        ctx = GadgetContext(
+            desc, gadget_params=gadget_params, operator_params=op_params,
+            timeout=float(run.get("timeout") or 0),
+            run_id=run.get("run_id") or None,
+        )
+        with self._runs_mu:
+            self._runs[ctx.run_id] = ctx
+
+        out_q: queue.Queue = queue.Queue(maxsize=EVENT_BUFFER)
+        dropped = [0]
+        seq = [0]
+
+        def push(kind: int, header: dict, payload: bytes = b""):
+            seq[0] += 1
+            header = {**header, "seq": seq[0], "type": kind}
+            try:
+                out_q.put_nowait(wire.encode_msg(header, payload))
+            except queue.Full:
+                dropped[0] += 1  # ref: service.go:160-167 drop-on-full
+
+        cols = desc.columns()
+
+        def row_dict(ev) -> dict:
+            d = cols.to_dict(ev)
+            d["node"] = self.node_name  # authoritative node identity
+            return d
+
+        def on_event(ev):
+            if "json" in outputs:
+                push(wire.EV_PAYLOAD_JSON, {"node": self.node_name},
+                     json.dumps(row_dict(ev), default=str).encode())
+
+        def on_event_array(evs):
+            if "json" in outputs:
+                payload = json.dumps(
+                    [row_dict(e) for e in evs], default=str).encode()
+                push(wire.EV_PAYLOAD_ARRAY, {"node": self.node_name}, payload)
+
+        def on_batch(batch):
+            if "batch" in outputs and batch.count:
+                push(wire.EV_BATCH_NPZ, {"node": self.node_name,
+                                         "drops": batch.drops},
+                     wire.encode_batch(batch))
+
+        if "summary" in outputs:
+            def on_summary(summary):
+                h, payload = wire.encode_summary(summary)
+                push(wire.EV_SUMMARY, {"node": self.node_name, **h}, payload)
+            ctx.extra["on_sketch_summary"] = on_summary
+
+        # control reader: client stop requests cancel the context
+        def control_loop():
+            try:
+                for msg in request_iterator:
+                    h, _ = wire.decode_msg(msg)
+                    if h.get("stop"):
+                        ctx.cancel()
+                        return
+            except Exception:
+                ctx.cancel()
+
+        threading.Thread(target=control_loop, daemon=True).start()
+
+        result_holder = {}
+
+        def run_thread():
+            try:
+                res = self.runtime.run_gadget(
+                    ctx,
+                    on_event=on_event if desc.gadget_type == GadgetType.TRACE else None,
+                    on_event_array=on_event_array
+                    if desc.gadget_type == GadgetType.TRACE_INTERVALS else None,
+                    on_batch=on_batch,
+                )
+                result_holder["result"] = res
+            finally:
+                out_q.put(None)  # sentinel
+
+        t = threading.Thread(target=run_thread, daemon=True)
+        t.start()
+
+        while True:
+            item = out_q.get()
+            if item is None:
+                break
+            yield item
+            if not context.is_active():
+                ctx.cancel()
+                break
+
+        t.join(timeout=5.0)
+        res = result_holder.get("result")
+        if res is not None:
+            node_res = res.get(self.node_name)
+            if node_res is not None and node_res.error:
+                yield wire.encode_msg({"type": wire.EV_RESULT, "error": node_res.error})
+            elif node_res is not None and isinstance(node_res.result, bytes):
+                yield wire.encode_msg({"type": wire.EV_RESULT}, node_res.result)
+        if dropped[0]:
+            yield wire.encode_msg({"type": wire.EV_CONTROL_ACK,
+                                   "dropped": dropped[0]})
+        with self._runs_mu:
+            self._runs.pop(ctx.run_id, None)
+
+    # -- ContainerManager (hook-facing; ref: gadgettracermanager.go:151) ----
+
+    def add_container(self, request: bytes, context) -> bytes:
+        h, _ = wire.decode_msg(request)
+        from ..operators.operators import get as get_op
+        lm = get_op("localmanager")
+        if lm.cc is None:
+            lm.init(lm.global_params().to_params())
+        c = h.get("container", {})
+        lm.cc.add_container(Container(
+            id=c.get("id", ""), name=c.get("name", ""),
+            pid=int(c.get("pid", 0)), mntns=int(c.get("mntns", 0)),
+            netns=int(c.get("netns", 0)), namespace=c.get("namespace", ""),
+            pod=c.get("pod", ""), labels=c.get("labels", {}),
+        ))
+        return wire.encode_msg({"ok": True, "count": len(lm.cc)})
+
+    def remove_container(self, request: bytes, context) -> bytes:
+        h, _ = wire.decode_msg(request)
+        from ..operators.operators import get as get_op
+        lm = get_op("localmanager")
+        if lm.cc is not None:
+            lm.cc.remove_container(h.get("container", {}).get("id", ""))
+        return wire.encode_msg({"ok": True})
+
+    # -- dump-state debug RPC (ref: gadgettracermanager.go DumpState :204) --
+
+    def dump_state(self, request: bytes, context) -> bytes:
+        import sys
+        frames = {}
+        for tid, frame in sys._current_frames().items():
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 32:
+                stack.append(f"{f.f_code.co_filename}:{f.f_lineno} {f.f_code.co_name}")
+                f = f.f_back
+            frames[str(tid)] = stack
+        with self._runs_mu:
+            runs = list(self._runs)
+        return wire.encode_msg({"threads": frames, "active_runs": runs})
+
+
+def _method(behavior, kind):
+    s, d = wire.identity_serializer, wire.identity_deserializer
+    if kind == "unary":
+        return grpc.unary_unary_rpc_method_handler(
+            behavior, request_deserializer=d, response_serializer=s)
+    return grpc.stream_stream_rpc_method_handler(
+        behavior, request_deserializer=d, response_serializer=s)
+
+
+def serve(address: str = "unix:///tmp/igtpu-agent.sock",
+          node_name: str = "node", max_workers: int = 8) -> tuple[grpc.Server, AgentServer]:
+    """Start the agent (non-blocking); returns (grpc_server, agent)."""
+    agent = AgentServer(node_name=node_name)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    handlers = {
+        "GetCatalog": _method(agent.get_catalog, "unary"),
+        "RunGadget": _method(agent.run_gadget, "stream"),
+        "AddContainer": _method(agent.add_container, "unary"),
+        "RemoveContainer": _method(agent.remove_container, "unary"),
+        "DumpState": _method(agent.dump_state, "unary"),
+    }
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler("igtpu.GadgetManager", handlers),
+    ))
+    # standard health service analogue (ref: main.go:224-245)
+    server.add_insecure_port(address)
+    server.start()
+    return server, agent

@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _add_common_flags(gp: argparse.ArgumentParser) -> None:
+    gp.add_argument("--remote", default="",
+                    help="fan out to agents: name=target[,name=target...] "
+                         "(the kubectl-gadget mode)")
+    gp.add_argument("--node", default="", help="restrict --remote to one node")
     gp.add_argument("-o", "--output", default="columns",
                     choices=["columns", "json"], help="output format")
     gp.add_argument("--timeout", type=float, default=0.0,
@@ -133,6 +137,10 @@ def cmd_run(args) -> int:
         timeout=args.timeout,
     )
 
+    if args.remote:
+        from ..environment import Environment, set_environment
+        set_environment(Environment.KUBERNETES)  # show node columns
+
     cols = ctx.columns
     filters = parse_filters(args.filter, cols) if args.filter and cols else []
     if cols is not None:
@@ -171,16 +179,25 @@ def cmd_run(args) -> int:
             out.write("\n" + formatter.format_table(rows) + "\n")
         out.flush()
 
-    from ..runtime.local import LocalRuntime
-    runtime = LocalRuntime()
-
     def on_sigint(signum, frame):
         ctx.cancel()
 
     signal.signal(signal.SIGINT, on_sigint)
-    if args.timeout > 0:
-        import threading
-        threading.Thread(target=ctx.wait_for_timeout_or_done, daemon=True).start()
+
+    if args.remote:
+        from ..runtime.grpc_runtime import GrpcRuntime
+        targets = dict(kv.split("=", 1) for kv in args.remote.split(","))
+        runtime = GrpcRuntime(targets)
+        if args.node:
+            ctx.runtime_params = runtime.params().to_params()
+            ctx.runtime_params.set("node", args.node)
+    else:
+        from ..runtime.local import LocalRuntime
+        runtime = LocalRuntime()
+        if args.timeout > 0:
+            import threading
+            threading.Thread(target=ctx.wait_for_timeout_or_done,
+                             daemon=True).start()
 
     result = runtime.run_gadget(
         ctx,

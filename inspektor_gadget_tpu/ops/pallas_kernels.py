@@ -1,0 +1,93 @@
+"""Pallas TPU kernels for the sketch hot path.
+
+Design note (measured, see bench.py): the wide count-min table (W=65536)
+ingests fastest through XLA's native scatter-add — the sort/segment
+machinery XLA emits for scatter is already near memory-bound. Where Pallas
+wins is the *narrow* histogram planes (entropy sketch W≤4096, autoencoder
+count-vector binning): there a one-hot matmul keeps all the work on the MXU
+with zero scatter serialization — each grid step materializes a one-hot
+tile in VMEM (never HBM) and accumulates weights @ onehot.
+
+hist[w] = Σ_n weights[n] * [bucket(keys[n]) == w]
+
+Kernel contract: fixed shapes, f32 accumulation (exact for batch counts
+< 2^24), uint32 hashing on the VPU, fori_loop over batch chunks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N_CHUNK = 256    # batch rows per MXU matmul step
+W_TILE = 512     # histogram buckets per grid step (lane-aligned)
+
+
+def _fmix32(h):
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def _hist_kernel(keys_ref, w_ref, out_ref, *, log2_width: int, mult: int,
+                 salt: int, n_chunks: int):
+    tile = pl.program_id(0)
+    keys = keys_ref[:].astype(jnp.uint32)
+    h = _fmix32(keys * jnp.uint32(mult) + jnp.uint32(salt))
+    idx = (h >> (32 - log2_width)).astype(jnp.int32)
+    local = idx - tile * W_TILE  # bucket position inside this width tile
+    weights = w_ref[:]
+
+    def body(c, acc):
+        lk = jax.lax.dynamic_slice(local, (c * N_CHUNK,), (N_CHUNK,))
+        wk = jax.lax.dynamic_slice(weights, (c * N_CHUNK,), (N_CHUNK,))
+        onehot = (lk[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (N_CHUNK, W_TILE), 1)).astype(jnp.float32)
+        return acc + jnp.dot(wk[None, :], onehot,
+                             preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(
+        0, n_chunks, body, jnp.zeros((1, W_TILE), jnp.float32))
+    out_ref[0, :] = acc[0]
+
+
+@functools.partial(jax.jit, static_argnames=("log2_width", "mult", "salt"))
+def pallas_histogram(keys: jnp.ndarray, weights: jnp.ndarray, *,
+                     log2_width: int, mult: int = 0x9E3779B1,
+                     salt: int = 0) -> jnp.ndarray:
+    """(n,) uint32 keys + (n,) f32 weights → (2**log2_width,) f32 histogram.
+    n must be a multiple of N_CHUNK; width a multiple of W_TILE (pad the
+    sketch config, not the data)."""
+    n = keys.shape[0]
+    width = 1 << log2_width
+    assert n % N_CHUNK == 0 and width % W_TILE == 0
+    kernel = functools.partial(
+        _hist_kernel, log2_width=log2_width, mult=mult, salt=salt,
+        n_chunks=n // N_CHUNK)
+    out = pl.pallas_call(
+        kernel,
+        grid=(width // W_TILE,),
+        in_specs=[
+            pl.BlockSpec((n,), lambda t: (0,)),
+            pl.BlockSpec((n,), lambda t: (0,)),
+        ],
+        out_specs=pl.BlockSpec((1, W_TILE), lambda t: (t, 0)),
+        out_shape=jax.ShapeDtypeStruct((width // W_TILE, W_TILE), jnp.float32),
+    )(keys, weights.astype(jnp.float32))
+    return out.reshape(width)
+
+
+def xla_histogram(keys: jnp.ndarray, weights: jnp.ndarray, *,
+                  log2_width: int, mult: int = 0x9E3779B1,
+                  salt: int = 0) -> jnp.ndarray:
+    """Scatter-add reference implementation (same hash)."""
+    h = _fmix32(keys.astype(jnp.uint32) * jnp.uint32(mult) + jnp.uint32(salt))
+    idx = (h >> (32 - log2_width)).astype(jnp.int32)
+    return jnp.zeros(1 << log2_width, jnp.float32).at[idx].add(
+        weights.astype(jnp.float32))

@@ -6,4 +6,13 @@ CombinedGadgetResult :42-47 for per-node results/errors).
 from .runtime import Runtime, GadgetResult, CombinedGadgetResult
 from .local import LocalRuntime
 
-__all__ = ["Runtime", "GadgetResult", "CombinedGadgetResult", "LocalRuntime"]
+__all__ = ["Runtime", "GadgetResult", "CombinedGadgetResult", "LocalRuntime",
+           "GrpcRuntime"]
+
+
+def __getattr__(name):
+    # lazy: GrpcRuntime pulls in grpc only when used
+    if name == "GrpcRuntime":
+        from .grpc_runtime import GrpcRuntime
+        return GrpcRuntime
+    raise AttributeError(name)
