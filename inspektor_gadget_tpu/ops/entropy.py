@@ -36,6 +36,15 @@ def entropy_update(
 ) -> EntropySketch:
     if weights is None:
         weights = jnp.ones(keys.shape, dtype=jnp.float32)
+    # On TPU with aligned shapes, the MXU one-hot-matmul histogram kernel
+    # beats XLA scatter (measured ~19µs vs ~23µs per 131k batch at W=4096);
+    # scatter elsewhere. Hash family identical in both paths.
+    n, width = keys.shape[0], state.counts.shape[0]
+    if (jax.default_backend() == "tpu" and n % 256 == 0 and width % 1024 == 0):
+        from .pallas_kernels import pallas_histogram
+        hist = pallas_histogram(keys, weights.astype(jnp.float32),
+                                log2_width=state.log2_width)
+        return state.replace(counts=state.counts + hist)
     idx = multiply_shift(keys, 0, state.log2_width)
     return state.replace(counts=state.counts.at[idx].add(weights.astype(jnp.float32)))
 

@@ -24,7 +24,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 N_CHUNK = 256    # batch rows per MXU matmul step
-W_TILE = 512     # histogram buckets per grid step (lane-aligned)
+W_TILE = 1024    # histogram buckets per grid step, laid out as (8, 128)
 
 
 def _fmix32(h):
@@ -38,23 +38,22 @@ def _fmix32(h):
 def _hist_kernel(keys_ref, w_ref, out_ref, *, log2_width: int, mult: int,
                  salt: int, n_chunks: int):
     tile = pl.program_id(0)
-    keys = keys_ref[:].astype(jnp.uint32)
-    h = _fmix32(keys * jnp.uint32(mult) + jnp.uint32(salt))
-    idx = (h >> (32 - log2_width)).astype(jnp.int32)
-    local = idx - tile * W_TILE  # bucket position inside this width tile
-    weights = w_ref[:]
 
     def body(c, acc):
-        lk = jax.lax.dynamic_slice(local, (c * N_CHUNK,), (N_CHUNK,))
-        wk = jax.lax.dynamic_slice(weights, (c * N_CHUNK,), (N_CHUNK,))
-        onehot = (lk[:, None] == jax.lax.broadcasted_iota(
+        keys = keys_ref[c, :]
+        wk = w_ref[c, :]
+        h = _fmix32(keys.astype(jnp.uint32) * jnp.uint32(mult)
+                    + jnp.uint32(salt))
+        idx = (h >> (32 - log2_width)).astype(jnp.int32)
+        local = idx - tile * W_TILE  # bucket position inside this width tile
+        onehot = (local[:, None] == jax.lax.broadcasted_iota(
             jnp.int32, (N_CHUNK, W_TILE), 1)).astype(jnp.float32)
         return acc + jnp.dot(wk[None, :], onehot,
                              preferred_element_type=jnp.float32)
 
     acc = jax.lax.fori_loop(
         0, n_chunks, body, jnp.zeros((1, W_TILE), jnp.float32))
-    out_ref[0, :] = acc[0]
+    out_ref[0, :, :] = acc.reshape(8, 128)
 
 
 @functools.partial(jax.jit, static_argnames=("log2_width", "mult", "salt"))
@@ -67,19 +66,22 @@ def pallas_histogram(keys: jnp.ndarray, weights: jnp.ndarray, *,
     n = keys.shape[0]
     width = 1 << log2_width
     assert n % N_CHUNK == 0 and width % W_TILE == 0
+    n_chunks = n // N_CHUNK
+    keys2 = keys.reshape(n_chunks, N_CHUNK)
+    w2 = weights.astype(jnp.float32).reshape(n_chunks, N_CHUNK)
     kernel = functools.partial(
         _hist_kernel, log2_width=log2_width, mult=mult, salt=salt,
-        n_chunks=n // N_CHUNK)
+        n_chunks=n_chunks)
     out = pl.pallas_call(
         kernel,
         grid=(width // W_TILE,),
         in_specs=[
-            pl.BlockSpec((n,), lambda t: (0,)),
-            pl.BlockSpec((n,), lambda t: (0,)),
+            pl.BlockSpec((n_chunks, N_CHUNK), lambda t: (0, 0)),
+            pl.BlockSpec((n_chunks, N_CHUNK), lambda t: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, W_TILE), lambda t: (t, 0)),
-        out_shape=jax.ShapeDtypeStruct((width // W_TILE, W_TILE), jnp.float32),
-    )(keys, weights.astype(jnp.float32))
+        out_specs=pl.BlockSpec((1, 8, 128), lambda t: (t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((width // W_TILE, 8, 128), jnp.float32),
+    )(keys2, w2)
     return out.reshape(width)
 
 
