@@ -107,3 +107,66 @@ def ae_train_step(
     updates, opt_state = _optimizer(scorer.config).update(grads, scorer.opt_state, scorer.params)
     params = optax.apply_updates(scorer.params, updates)
     return scorer.replace(params=params, opt_state=opt_state, steps=scorer.steps + 1), loss
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel variant (Megatron MLP pattern over the mesh 'model' axis):
+# enc1/dec1 column-parallel (hidden sharded, no collective), enc2/dec2
+# row-parallel (contract over the sharded hidden → one psum each). Two
+# psums per forward; activations stay sharded through the gelu.
+# ---------------------------------------------------------------------------
+
+
+def ae_param_pspecs(model_axis: str = "model"):
+    """PartitionSpec tree for tensor-parallel autoencoder params."""
+    from jax.sharding import PartitionSpec as P
+
+    col = {"w": P(None, model_axis), "b": P(model_axis)}   # column-parallel
+    row = {"w": P(model_axis, None), "b": P()}             # row-parallel
+    return {"enc1": col, "enc2": row, "dec1": col, "dec2": row}
+
+
+def ae_apply_tp(params: dict, x: jnp.ndarray, cfg: AEConfig,
+                model_axis: str = "model") -> jnp.ndarray:
+    dt = cfg.compute_dtype
+    h = jax.nn.gelu(_layer(x, params["enc1"], dt))          # (b, hidden/m)
+    z = jax.lax.psum(
+        (h.astype(dt) @ params["enc2"]["w"].astype(dt)), model_axis
+    ) + params["enc2"]["b"].astype(dt)
+    z = jax.nn.gelu(z)                                      # (b, latent) repl
+    h2 = jax.nn.gelu(_layer(z, params["dec1"], dt))         # (b, hidden/m)
+    out = jax.lax.psum(
+        (h2.astype(dt) @ params["dec2"]["w"].astype(dt)), model_axis
+    ) + params["dec2"]["b"].astype(dt)
+    return out.astype(jnp.float32)
+
+
+def ae_loss_tp(params: dict, x: jnp.ndarray, cfg: AEConfig,
+               model_axis: str = "model") -> jnp.ndarray:
+    recon = ae_apply_tp(params, x, cfg, model_axis)
+    return jnp.mean((recon - x) ** 2)
+
+
+def ae_score_tp(scorer: AnomalyScorer, x: jnp.ndarray,
+                model_axis: str = "model") -> jnp.ndarray:
+    recon = ae_apply_tp(scorer.params, x, scorer.config, model_axis)
+    return jnp.mean((recon - x) ** 2, axis=-1) * x.shape[-1]
+
+
+def ae_train_step_tp(
+    scorer: AnomalyScorer, x: jnp.ndarray, *, dp_axis: str | None = "node",
+    model_axis: str = "model",
+) -> tuple[AnomalyScorer, jnp.ndarray]:
+    """DP×TP step under shard_map: forward/backward with model-axis psums
+    (autodiff transposes them correctly), grads pmean'd over the data axis,
+    per-shard Adam update (optimizer state shards like the params)."""
+    loss, grads = jax.value_and_grad(ae_loss_tp)(
+        scorer.params, x, scorer.config, model_axis)
+    if dp_axis is not None:
+        grads = jax.lax.pmean(grads, dp_axis)
+        loss = jax.lax.pmean(loss, dp_axis)
+    updates, opt_state = _optimizer(scorer.config).update(
+        grads, scorer.opt_state, scorer.params)
+    params = optax.apply_updates(scorer.params, updates)
+    return scorer.replace(params=params, opt_state=opt_state,
+                          steps=scorer.steps + 1), loss

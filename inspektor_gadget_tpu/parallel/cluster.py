@@ -25,13 +25,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.autoencoder import AnomalyScorer, ae_train_step, normalize_counts
+from ..models.autoencoder import (
+    AnomalyScorer,
+    ae_param_pspecs,
+    ae_train_step,
+    ae_train_step_tp,
+    normalize_counts,
+)
 from ..ops.countmin import cms_psum
 from ..ops.entropy import entropy_psum
 from ..ops.hll import hll_pmax
 from ..ops.sketches import SketchBundle, bundle_init, bundle_update
 from ..ops.topk import topk_gather_merge
-from .mesh import NODE_AXIS
+from .mesh import MODEL_AXIS, NODE_AXIS
 
 
 @flax.struct.dataclass
@@ -42,10 +48,33 @@ class ClusterState:
     scorer: AnomalyScorer
 
 
+def scorer_pspecs(scorer: AnomalyScorer, model_axis: str = MODEL_AXIS):
+    """PartitionSpec tree for the scorer: Megatron row/col sharding on the
+    params and matching sharding on Adam's mu/nu (same inner structure)."""
+    pp = ae_param_pspecs(model_axis)
+
+    def for_path(path, _leaf):
+        keys = [k.key for k in path
+                if isinstance(k, jax.tree_util.DictKey)]
+        for layer in ("enc1", "enc2", "dec1", "dec2"):
+            if layer in keys:
+                return pp[layer]["w" if "w" in keys else "b"]
+        return P()
+
+    return AnomalyScorer(
+        params=jax.tree_util.tree_map_with_path(for_path, scorer.params),
+        opt_state=jax.tree_util.tree_map_with_path(for_path, scorer.opt_state),
+        steps=P(),
+        config=scorer.config,
+    )
+
+
 def cluster_init(mesh: Mesh, scorer: AnomalyScorer, **bundle_kw) -> ClusterState:
     """Materialize state with the right shardings: bundle arrays get a
-    leading node-axis dim (one bundle per node), scorer replicates."""
+    leading node-axis dim (one bundle per node); the scorer replicates on a
+    1-D mesh and tensor-shards over the 'model' axis on a 2-D mesh."""
     n = mesh.shape[NODE_AXIS]
+    tp = mesh.shape.get(MODEL_AXIS, 1) > 1
 
     def stack(x):
         return jax.device_put(
@@ -54,7 +83,14 @@ def cluster_init(mesh: Mesh, scorer: AnomalyScorer, **bundle_kw) -> ClusterState
         )
 
     bundle = jax.tree.map(stack, bundle_init(**bundle_kw))
-    scorer = jax.device_put(scorer, NamedSharding(mesh, P()))
+    if tp:
+        specs = scorer_pspecs(scorer)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        scorer = jax.device_put(scorer, shardings)
+    else:
+        scorer = jax.device_put(scorer, NamedSharding(mesh, P()))
     return ClusterState(bundle=bundle, scorer=scorer)
 
 
@@ -65,13 +101,17 @@ def cluster_sketch_step(
     dist_keys: jnp.ndarray,
     mask: jnp.ndarray,         # (n_nodes, batch) bool
     ae_batch: jnp.ndarray,     # (n_nodes, rows, input_dim) float32 counts
+    use_tp: bool = False,
 ) -> tuple[ClusterState, jnp.ndarray]:
     """Per-node shard body (runs under shard_map; leading node dim is 1)."""
     bundle = jax.tree.map(lambda x: x[0], state.bundle)
     bundle = bundle_update(bundle, hh_keys[0], distinct_keys[0], dist_keys[0], mask[0])
-    scorer, loss = ae_train_step(
-        state.scorer, normalize_counts(ae_batch[0]), axis_name=NODE_AXIS
-    )
+    x = normalize_counts(ae_batch[0])
+    if use_tp:
+        scorer, loss = ae_train_step_tp(
+            state.scorer, x, dp_axis=NODE_AXIS, model_axis=MODEL_AXIS)
+    else:
+        scorer, loss = ae_train_step(state.scorer, x, axis_name=NODE_AXIS)
     bundle = jax.tree.map(lambda x: x[None], bundle)
     return ClusterState(bundle=bundle, scorer=scorer), loss
 
@@ -107,15 +147,18 @@ def make_cluster_step(mesh: Mesh, state: ClusterState):
     merge(bundle_sharded) -> replicated cluster SketchBundle
       the harvest-tick collective (snapshotcombiner analogue).
     """
+    use_tp = mesh.shape.get(MODEL_AXIS, 1) > 1
     state_specs = ClusterState(
         bundle=_specs_like(state.bundle, P(NODE_AXIS)),
-        scorer=_specs_like(state.scorer, P()),
+        scorer=(scorer_pspecs(state.scorer) if use_tp
+                else _specs_like(state.scorer, P())),
     )
     batch_spec = P(NODE_AXIS)
 
+    import functools
     step = jax.jit(
         jax.shard_map(
-            cluster_sketch_step,
+            functools.partial(cluster_sketch_step, use_tp=use_tp),
             mesh=mesh,
             in_specs=(state_specs, batch_spec, batch_spec, batch_spec,
                       batch_spec, batch_spec),

@@ -124,3 +124,55 @@ def test_scorer_stays_replicated_and_synced():
     shards = [np.asarray(s.data) for s in w.addressable_shards]
     for s in shards[1:]:
         np.testing.assert_array_equal(shards[0], s)
+
+
+def test_tp_autoencoder_matches_replicated():
+    """TP forward (Megatron sharding, psum contractions) must equal the
+    single-device forward on the same weights."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from inspektor_gadget_tpu.models.autoencoder import (
+        AEConfig, ae_init, ae_apply, ae_apply_tp)
+    from inspektor_gadget_tpu.parallel.cluster import scorer_pspecs
+    from inspektor_gadget_tpu.parallel import make_mesh
+
+    cfg = AEConfig(input_dim=128, hidden_dim=128, latent_dim=32,
+                   compute_dtype=jnp.float32)
+    scorer = ae_init(cfg, seed=3)
+    x = normalize_counts(jnp.asarray(
+        np.random.default_rng(0).poisson(4.0, (8, 128)).astype(np.float32)))
+    ref = ae_apply(scorer.params, x, cfg)
+
+    mesh = make_mesh(n_nodes=4, n_model=2)
+    specs = scorer_pspecs(scorer)
+    tp_fn = jax.jit(jax.shard_map(
+        lambda p, xx: ae_apply_tp(p, xx, cfg, model_axis="model"),
+        mesh=mesh,
+        in_specs=(specs.params, P()),
+        out_specs=P(),
+        check_vma=False,
+    ))
+    sharded_params = jax.device_put(
+        scorer.params,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), specs.params,
+                     is_leaf=lambda v: isinstance(v, P)))
+    out = tp_fn(sharded_params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cluster_step_tp_mode():
+    mesh = make_mesh(n_nodes=4, n_model=2)
+    scorer = ae_init(AEConfig(input_dim=DIM, hidden_dim=128, latent_dim=32))
+    state = cluster_init(mesh, scorer, **small_bundle_kw())
+    step, merge = make_cluster_step(mesh, state)
+    rng = np.random.default_rng(9)
+    keys = rng.integers(1, 2**32, (4, BATCH), dtype=np.uint32)
+    mask = np.ones((4, BATCH), bool)
+    ae_batch = rng.poisson(3.0, (4, 8, DIM)).astype(np.float32)
+    state, loss = step(state, jnp.asarray(keys), jnp.asarray(keys),
+                       jnp.asarray(keys), jnp.asarray(mask),
+                       jnp.asarray(ae_batch))
+    assert np.isfinite(float(loss))
+    merged = merge(state.bundle)
+    assert float(merged.events) == 4 * BATCH
