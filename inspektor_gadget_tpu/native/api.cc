@@ -13,6 +13,7 @@
 #include <unordered_map>
 
 #include "sources.cc"
+#include "packet.cc"
 
 using namespace ig;
 
@@ -39,6 +40,9 @@ enum {
   IG_SRC_SYNTH_DNS = 3,
   IG_SRC_PROC_EXEC = 100,
   IG_SRC_PROC_TCP = 101,
+  IG_SRC_PKT_DNS = 200,
+  IG_SRC_PKT_SNI = 201,
+  IG_SRC_PKT_FLOW = 202,
 };
 
 uint64_t ig_source_create(uint32_t kind, uint64_t seed, double rate,
@@ -61,6 +65,17 @@ uint64_t ig_source_create(uint32_t kind, uint64_t seed, double rate,
       break;
     case IG_SRC_PROC_TCP:
       s = new ProcTcpSource(cap);
+      break;
+    case IG_SRC_PKT_DNS:
+      // seed doubles as an optional netns fd (0 = current netns) — the
+      // rawsock "open in target namespace" contract
+      s = new PacketSniffSource(cap, PKT_DNS, seed ? (int)seed : -1);
+      break;
+    case IG_SRC_PKT_SNI:
+      s = new PacketSniffSource(cap, PKT_SNI, seed ? (int)seed : -1);
+      break;
+    case IG_SRC_PKT_FLOW:
+      s = new PacketSniffSource(cap, PKT_FLOW, seed ? (int)seed : -1);
       break;
 #endif
     default:

@@ -25,6 +25,9 @@ SRC_SYNTH_TCP = 2
 SRC_SYNTH_DNS = 3
 SRC_PROC_EXEC = 100
 SRC_PROC_TCP = 101
+SRC_PKT_DNS = 200
+SRC_PKT_SNI = 201
+SRC_PKT_FLOW = 202
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libigcapture.so"
