@@ -1,0 +1,91 @@
+"""Gadget startup-latency benchmark.
+
+The reference's only in-tree benchmark: startup latency of every gadget
+with {0, 1, 10, 100} fake containers, published per-commit
+(internal/benchmarks/benchmarks_test.go:188-282). Same harness here:
+seed the container collection with N fake containers, then measure
+run-to-first-teardown latency per gadget. Run:
+
+    python -m benchmarks.startup [--containers 0,1,10,100] [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.containers import Container
+from inspektor_gadget_tpu.gadgets import GadgetContext, get_all
+from inspektor_gadget_tpu.operators.operators import get as get_op
+from inspektor_gadget_tpu.runtime import LocalRuntime
+
+# legacy-path + long-running collectors excluded, as the reference excludes
+# its CRD-path gadgets from the startup matrix
+SKIP = {("advise", "seccomp-profile"), ("advise", "network-policy"),
+        ("profile", "cpu"), ("profile", "block-io"),
+        ("traceloop", "traceloop")}
+
+
+def seed_containers(n: int) -> None:
+    lm = get_op("localmanager")
+    if lm.cc is None:
+        lm.init(lm.global_params().to_params())
+    for i in range(n):
+        lm.cc.add_container(Container(
+            id=f"bench-{i}", name=f"bench-{i}", pid=1,
+            mntns=900000 + i, namespace="bench", pod=f"pod-{i}"))
+
+
+def clear_containers() -> None:
+    lm = get_op("localmanager")
+    if lm.cc is not None:
+        for c in list(lm.cc.get_all()):
+            if c.id.startswith("bench-"):
+                lm.cc.remove_container(c.id)
+
+
+def bench_gadget(desc, runtime) -> float:
+    params = desc.params().to_params()
+    if "source" in params:
+        params.set("source", "pysynthetic")
+        params.set("rate", "1000")
+    ctx = GadgetContext(desc, gadget_params=params, timeout=0.15)
+    t0 = time.perf_counter()
+    runtime.run_gadget(ctx)
+    return time.perf_counter() - t0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--containers", default="0,1,10,100")
+    ap.add_argument("--json", default="")
+    args = ap.parse_args(argv)
+
+    runtime = LocalRuntime()
+    results = []
+    for n in [int(x) for x in args.containers.split(",")]:
+        seed_containers(n)
+        try:
+            for desc in get_all():
+                if (desc.category, desc.name) in SKIP:
+                    continue
+                dt = bench_gadget(desc, runtime)
+                results.append({
+                    "gadget": desc.full_name, "containers": n,
+                    "startup_ms": round((dt - 0.15) * 1000, 2),
+                })
+        finally:
+            clear_containers()
+    for r in results:
+        print(f"{r['gadget']:24s} n={r['containers']:<4d} "
+              f"startup={r['startup_ms']:.2f} ms")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

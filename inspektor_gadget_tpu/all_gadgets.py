@@ -19,3 +19,5 @@ from .gadgets.advise import network_policy as _advise_netpol  # noqa: F401
 from .gadgets.traceloop import traceloop as _traceloop  # noqa: F401
 from .operators import localmanager as _localmanager  # noqa: F401
 from .operators import tpusketch as _tpusketch  # noqa: F401
+from .operators import kubemanager as _kubemanager  # noqa: F401
+from .operators import kubeipresolver as _kubeipresolver  # noqa: F401

@@ -1,0 +1,106 @@
+"""deploy/undeploy: render + manage the per-node agent rollout.
+
+Reference contract: cmd/kubectl-gadget/deploy.go (546 LoC) renders embedded
+manifests (DaemonSet, ServiceAccount, RBAC, CRD — pkg/resources/manifests)
+and applies them, waiting for rollout; undeploy.go removes them. Without a
+live kube API here, `deploy --render` emits the equivalent manifests
+(DaemonSet running the agent with TPU resources + hostPID for capture,
+RBAC, namespace) for kubectl, and `deploy --local n` starts n local agent
+daemons for development — the minikube analogue.
+"""
+
+from __future__ import annotations
+
+AGENT_IMAGE = "ghcr.io/inspektor-gadget-tpu/agent:latest"
+NAMESPACE = "ig-tpu"
+
+
+def render_manifests(image: str = AGENT_IMAGE, namespace: str = NAMESPACE,
+                     tpu_resource: str = "google.com/tpu",
+                     tpus_per_node: int = 4) -> str:
+    return f"""apiVersion: v1
+kind: Namespace
+metadata:
+  name: {namespace}
+---
+apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: ig-tpu-agent
+  namespace: {namespace}
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata:
+  name: ig-tpu-agent
+rules:
+- apiGroups: [""]
+  resources: [pods, services, nodes]
+  verbs: [get, list, watch]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRoleBinding
+metadata:
+  name: ig-tpu-agent
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: ClusterRole
+  name: ig-tpu-agent
+subjects:
+- kind: ServiceAccount
+  name: ig-tpu-agent
+  namespace: {namespace}
+---
+apiVersion: apps/v1
+kind: DaemonSet
+metadata:
+  name: ig-tpu-agent
+  namespace: {namespace}
+spec:
+  selector:
+    matchLabels: {{k8s-app: ig-tpu-agent}}
+  template:
+    metadata:
+      labels: {{k8s-app: ig-tpu-agent}}
+    spec:
+      serviceAccountName: ig-tpu-agent
+      hostPID: true
+      hostNetwork: true
+      containers:
+      - name: agent
+        image: {image}
+        command: [python, -m, inspektor_gadget_tpu.agent.main, serve,
+                  --listen, "tcp://0.0.0.0:50051",
+                  --node-name, "$(NODE_NAME)"]
+        env:
+        - name: NODE_NAME
+          valueFrom: {{fieldRef: {{fieldPath: spec.nodeName}}}}
+        securityContext:
+          capabilities: {{add: [NET_RAW, NET_ADMIN, SYS_PTRACE]}}
+        resources:
+          limits:
+            {tpu_resource}: {tpus_per_node}
+        volumeMounts:
+        - {{name: proc, mountPath: /host/proc, readOnly: true}}
+        - {{name: run, mountPath: /run}}
+      volumes:
+      - {{name: proc, hostPath: {{path: /proc}}}}
+      - {{name: run, hostPath: {{path: /run}}}}
+"""
+
+
+def deploy_local(n: int, base_port: int = 50151) -> dict[str, str]:
+    """Start n local agent daemons (subprocesses); returns node→target."""
+    import subprocess
+    import sys
+
+    targets = {}
+    for i in range(n):
+        port = base_port + i
+        subprocess.Popen(
+            [sys.executable, "-m", "inspektor_gadget_tpu.agent.main", "serve",
+             "--listen", f"127.0.0.1:{port}", "--node-name", f"node-{i}"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        targets[f"node-{i}"] = f"127.0.0.1:{port}"
+    return targets

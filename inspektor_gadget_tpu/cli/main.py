@@ -37,6 +37,17 @@ def build_parser() -> argparse.ArgumentParser:
     cp = sub.add_parser("catalog", help="print the full catalog as JSON")
     cp.set_defaults(func=cmd_catalog)
 
+    dp = sub.add_parser("deploy", help="render agent manifests / start local agents")
+    dp.add_argument("--render", action="store_true",
+                    help="print DaemonSet+RBAC manifests")
+    dp.add_argument("--local", type=int, default=0,
+                    help="start N local agent daemons")
+    dp.add_argument("--image", default="")
+    dp.set_defaults(func=cmd_deploy)
+
+    vp = sub.add_parser("version", help="print version")
+    vp.set_defaults(func=lambda a: (print(_version()), 0)[1])
+
     from ..gadgets.registry import categories
     for category, descs in categories().items():
         catp = sub.add_parser(category, help=f"{category} gadgets")
@@ -96,6 +107,25 @@ def cmd_catalog(args) -> int:
     from ..runtime.runtime import build_catalog
     print(json.dumps(build_catalog(), indent=2))
     return 0
+
+
+def _version() -> str:
+    from .. import __version__
+    return f"ig-tpu {__version__}"
+
+
+def cmd_deploy(args) -> int:
+    from .deploy import AGENT_IMAGE, deploy_local, render_manifests
+    if args.render:
+        print(render_manifests(image=args.image or AGENT_IMAGE))
+        return 0
+    if args.local > 0:
+        targets = deploy_local(args.local)
+        spec = ",".join(f"{k}={v}" for k, v in targets.items())
+        print(f"started {args.local} agents; use: --remote {spec}")
+        return 0
+    print("use --render or --local N", file=sys.stderr)
+    return 2
 
 
 def cmd_run(args) -> int:

@@ -86,6 +86,98 @@ def with_linux_namespace_enrichment():
     return opt
 
 
+def with_fanotify_discovery(paths: str = ""):
+    """Live container detection via the native fanotify exec-watch on
+    container-runtime binaries (ref: options.go:533 WithRuncFanotify →
+    pkg/runcfanotify). Each watched-binary exec becomes a container-start
+    candidate; EV_EXIT prunes. Degrades silently when fanotify or the
+    native library is unavailable."""
+
+    def opt(cc: ContainerCollection):
+        try:
+            from ..sources.bridge import NativeCapture, _load
+            lib = _load()
+            if lib is None or not lib.ig_fanotify_supported():
+                return
+        except Exception:
+            return
+        import threading
+
+        if paths:
+            os.environ["IG_FANOTIFY_PATHS"] = paths
+        src = NativeCapture(102, ring_pow2=14, batch_size=256)
+        src.start()
+
+        def pump():
+            import time as _t
+            while True:
+                b = src.pop()
+                for i in range(b.count):
+                    pid = int(b.cols["pid"][i])
+                    kind = int(b.cols["kind"][i])
+                    if kind == 1:  # EV_EXEC
+                        cc.add_container(Container(
+                            id=f"fan-{pid}", name=b.comm_str(i) or f"pid-{pid}",
+                            pid=pid, mntns=int(b.cols["mntns"][i]),
+                            runtime="fanotify",
+                        ))
+                    elif kind == 2:  # EV_EXIT
+                        cc.remove_container(f"fan-{pid}")
+                if b.count == 0:
+                    _t.sleep(0.05)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        cc._fanotify_source = src  # keep alive with the collection
+
+    return opt
+
+
+def with_netlink_discovery():
+    """Live process-lifecycle tracking via the netlink proc-connector
+    source: new mount namespaces appearing on exec become container
+    candidates; exits prune (the hookless runtime-agnostic path)."""
+
+    def opt(cc: ContainerCollection):
+        try:
+            from ..sources.bridge import NativeCapture, SRC_PROC_EXEC, native_available
+            if not native_available():
+                return
+        except Exception:
+            return
+        import threading
+
+        host_mntns = _read_ns(os.getpid(), "mnt")
+        src = NativeCapture(SRC_PROC_EXEC, ring_pow2=14, batch_size=256)
+        src.start()
+        pid_to_id: dict[int, str] = {}
+
+        def pump():
+            import time as _t
+            while True:
+                b = src.pop()
+                for i in range(b.count):
+                    pid = int(b.cols["pid"][i])
+                    kind = int(b.cols["kind"][i])
+                    mntns = int(b.cols["mntns"][i])
+                    if kind == 1 and mntns and mntns != host_mntns:
+                        cid = f"nl-{pid}"
+                        pid_to_id[pid] = cid
+                        cc.add_container(Container(
+                            id=cid, name=b.comm_str(i) or f"pid-{pid}",
+                            pid=pid, mntns=mntns, runtime="netlink",
+                        ))
+                    elif kind == 2 and pid in pid_to_id:
+                        cc.remove_container(pid_to_id.pop(pid))
+                if b.count == 0:
+                    _t.sleep(0.05)
+
+        threading.Thread(target=pump, daemon=True).start()
+        cc._netlink_source = src
+
+    return opt
+
+
 def with_procfs_discovery(max_pids: int = 4096):
     """Discover initial 'containers' by scanning /proc session leaders with
     distinct mount namespaces — the no-runtime-socket analogue of

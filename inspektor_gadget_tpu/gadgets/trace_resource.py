@@ -1,0 +1,166 @@
+"""Trace resource state machine — the legacy CRD control path.
+
+Reference contract (L9, SURVEY §1/§3.5): a `Trace` custom resource
+(pkg/apis/gadget/v1alpha1/types.go:24-140 — spec: node, gadget, filter,
+runMode, outputMode; status: state {Started,Stopped,Completed},
+operationError, output), driven by annotations carrying the requested
+operation; a reconciler on each node (pkg/controllers/trace_controller.go:
+100 — node filter, finalizers, operation dispatch) resolves the operation
+against a per-gadget TraceFactory
+(pkg/gadget-collection/gadgets/interface.go:32-50: Operations() map of
+name → {Operation(name, trace)}). `advise` and `traceloop` ride this path
+in the reference.
+
+Here the same shapes run against the modern gadget registry: a factory's
+start/stop/generate operations drive a background gadget run and park the
+result in trace.status.output — no kube API required, and an agent can host
+the reconciler to serve remote Trace lifecycles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable
+
+from ..params import Params
+from .context import GadgetContext
+from .registry import get as get_gadget
+
+STATE_STARTED = "Started"
+STATE_STOPPED = "Stopped"
+STATE_COMPLETED = "Completed"
+
+OPERATION_ANNOTATION = "gadget.ig-tpu.io/operation"  # ref: annotation key role
+
+
+@dataclasses.dataclass
+class TraceSpec:
+    node: str = ""
+    gadget: str = ""            # "category/name"
+    filter: dict = dataclasses.field(default_factory=dict)
+    run_mode: str = "manual"    # ref: RunMode auto|manual
+    output_mode: str = "Status"  # ref: OutputMode Status|Stream|File
+    parameters: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TraceStatus:
+    state: str = ""
+    operation_error: str = ""
+    output: str = ""
+
+
+@dataclasses.dataclass
+class TraceResource:
+    name: str
+    spec: TraceSpec
+    status: TraceStatus = dataclasses.field(default_factory=TraceStatus)
+    annotations: dict = dataclasses.field(default_factory=dict)
+
+
+class TraceRun:
+    """One live trace: a gadget running on a thread until stop."""
+
+    def __init__(self, ctx: GadgetContext, thread: threading.Thread,
+                 gadget: Any):
+        self.ctx = ctx
+        self.thread = thread
+        self.gadget = gadget
+
+
+class TraceReconciler:
+    """Node-side reconciler (ref: trace_controller.go:100 Reconcile)."""
+
+    def __init__(self, node_name: str = "local"):
+        self.node_name = node_name
+        self._runs: dict[str, TraceRun] = {}
+        self._mu = threading.Lock()
+
+    def reconcile(self, trace: TraceResource) -> TraceResource:
+        # node filter (ref: :172-175) — ignore traces for other nodes
+        if trace.spec.node and trace.spec.node != self.node_name:
+            return trace
+        op = trace.annotations.pop(OPERATION_ANNOTATION, "")
+        if not op:
+            return trace
+        try:
+            handler = {
+                "start": self._op_start,
+                "stop": self._op_stop,
+                "generate": self._op_generate,
+            }.get(op)
+            if handler is None:
+                raise ValueError(f"unsupported operation {op!r}")
+            handler(trace)
+            trace.status.operation_error = ""
+        except Exception as e:
+            trace.status.operation_error = str(e)
+        return trace
+
+    # operations (ref: TraceFactory.Operations() dispatch) ------------------
+
+    def _make_ctx(self, trace: TraceResource) -> tuple[GadgetContext, Any]:
+        category, _, name = trace.spec.gadget.partition("/")
+        desc = get_gadget(category, name)
+        params: Params = desc.params().to_params()
+        for k, v in trace.spec.parameters.items():
+            if k in params:
+                params.set(k, v)
+        ctx = GadgetContext(desc, gadget_params=params)
+        return ctx, desc
+
+    def _op_start(self, trace: TraceResource) -> None:
+        with self._mu:
+            if trace.name in self._runs:
+                raise ValueError(f"trace {trace.name!r} already started")
+        ctx, desc = self._make_ctx(trace)
+        gadget = desc.new_instance(ctx)
+        target = getattr(gadget, "run", None)
+        if hasattr(gadget, "run_with_result"):
+            def body():
+                try:
+                    ctx.result = gadget.run_with_result(ctx)
+                except Exception as e:
+                    ctx.error = e
+        else:
+            def body():
+                try:
+                    target(ctx)
+                except Exception as e:
+                    ctx.error = e
+        t = threading.Thread(target=body, daemon=True)
+        t.start()
+        with self._mu:
+            self._runs[trace.name] = TraceRun(ctx, t, gadget)
+        trace.status.state = STATE_STARTED
+
+    def _op_stop(self, trace: TraceResource) -> None:
+        with self._mu:
+            run = self._runs.get(trace.name)
+        if run is None:
+            raise ValueError(f"trace {trace.name!r} not running")
+        run.ctx.cancel()
+        run.thread.join(timeout=10.0)
+        trace.status.state = STATE_STOPPED
+
+    def _op_generate(self, trace: TraceResource) -> None:
+        """stop-if-needed + surface the gadget's rendered output in status
+        (ref: seccomp factory generate → trace.Status.Output, §3.5)."""
+        with self._mu:
+            run = self._runs.pop(trace.name, None)
+        if run is None:
+            raise ValueError(f"trace {trace.name!r} not running")
+        run.ctx.cancel()
+        run.thread.join(timeout=10.0)
+        if run.ctx.error is not None:
+            raise run.ctx.error
+        out = run.ctx.result
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        trace.status.output = out if isinstance(out, str) else str(out)
+        trace.status.state = STATE_COMPLETED
+
+    def active(self) -> list[str]:
+        with self._mu:
+            return list(self._runs)

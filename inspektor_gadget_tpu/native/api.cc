@@ -14,6 +14,7 @@
 
 #include "sources.cc"
 #include "packet.cc"
+#include "fanotify.cc"
 
 using namespace ig;
 
@@ -40,6 +41,7 @@ enum {
   IG_SRC_SYNTH_DNS = 3,
   IG_SRC_PROC_EXEC = 100,
   IG_SRC_PROC_TCP = 101,
+  IG_SRC_FANOTIFY_EXEC = 102,
   IG_SRC_PKT_DNS = 200,
   IG_SRC_PKT_SNI = 201,
   IG_SRC_PKT_FLOW = 202,
@@ -66,6 +68,24 @@ uint64_t ig_source_create(uint32_t kind, uint64_t seed, double rate,
     case IG_SRC_PROC_TCP:
       s = new ProcTcpSource(cap);
       break;
+    case IG_SRC_FANOTIFY_EXEC: {
+      // watched binaries from IG_FANOTIFY_PATHS (colon-separated); defaults
+      // to the usual runc locations (ref: runcfanotify runc watch)
+      std::vector<std::string> paths;
+      if (const char* env = getenv("IG_FANOTIFY_PATHS")) {
+        std::string all(env);
+        size_t pos = 0;
+        while (pos != std::string::npos) {
+          size_t next = all.find(':', pos);
+          std::string p = all.substr(
+              pos, next == std::string::npos ? next : next - pos);
+          if (!p.empty()) paths.push_back(p);
+          pos = next == std::string::npos ? next : next + 1;
+        }
+      }
+      s = new FanotifyExecSource(cap, std::move(paths));
+      break;
+    }
     case IG_SRC_PKT_DNS:
       // seed doubles as an optional netns fd (0 = current netns) — the
       // rawsock "open in target namespace" contract
@@ -181,3 +201,11 @@ uint64_t ig_fnv1a64(const char* s, int64_t n) {
 }
 
 }  // extern "C"
+
+extern "C" int ig_fanotify_supported() {
+#ifdef __linux__
+  return ig::FanotifyExecSource::supported() ? 1 : 0;
+#else
+  return 0;
+#endif
+}

@@ -1,0 +1,108 @@
+// FanotifyExecSource — container-runtime detection via fanotify.
+//
+// Reference contract: pkg/runcfanotify/runcfanotify.go — watches runc
+// binaries with FAN_OPEN_EXEC_PERM, reads the OCI bundle's config.json,
+// and emits container add/remove without any runtime hook (:144-300).
+// Here: FAN_OPEN_EXEC (non-permission flavour — observe, never gate) marks
+// on the configured binaries; each exec of a watched binary emits an
+// EV_EXEC event whose mntns/pid identify the new workload root. The
+// ContainerCollection consumes these as container-start candidates.
+
+#ifdef __linux__
+#include <fcntl.h>
+#include <sys/fanotify.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "ringbuf.h"
+
+namespace ig {
+
+class FanotifyExecSource : public Source {
+ public:
+  FanotifyExecSource(size_t ring_pow2, std::vector<std::string> paths)
+      : Source(ring_pow2), paths_(std::move(paths)) {
+    if (paths_.empty())
+      paths_ = {"/usr/bin/runc", "/usr/sbin/runc", "/usr/local/bin/runc"};
+  }
+  ~FanotifyExecSource() override { stop(); }
+
+  static bool supported() {  // ref: runcfanotify.go Supported():144
+    int fd = fanotify_init(FAN_CLASS_NOTIF | FAN_NONBLOCK,
+                           O_RDONLY | O_CLOEXEC);
+    if (fd < 0) return false;
+    close(fd);
+    return true;
+  }
+
+ protected:
+  void run() override {
+    int fan = fanotify_init(FAN_CLASS_NOTIF | FAN_NONBLOCK,
+                            O_RDONLY | O_LARGEFILE | O_CLOEXEC);
+    if (fan < 0) return;
+    bool any = false;
+    for (const auto& p : paths_) {
+      if (fanotify_mark(fan, FAN_MARK_ADD, FAN_OPEN_EXEC, AT_FDCWD,
+                        p.c_str()) == 0)
+        any = true;
+    }
+    if (!any) {
+      close(fan);
+      return;
+    }
+    char buf[4096];
+    while (running_.load(std::memory_order_relaxed)) {
+      ssize_t len = read(fan, buf, sizeof(buf));
+      if (len <= 0) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+        continue;
+      }
+      auto* md = (struct fanotify_event_metadata*)buf;
+      while (FAN_EVENT_OK(md, len)) {
+        if (md->mask & FAN_OPEN_EXEC) {
+          Event ev{};
+          ev.ts_ns = now_ns();
+          ev.kind = EV_EXEC;
+          ev.pid = (uint32_t)md->pid;
+          fill_identity(ev);
+          ring_.push(ev);
+        }
+        if (md->fd >= 0) close(md->fd);
+        md = FAN_EVENT_NEXT(md, len);
+      }
+    }
+    close(fan);
+  }
+
+ private:
+  void fill_identity(Event& ev) {
+    char path[64], buf[64];
+    snprintf(path, sizeof(path), "/proc/%u/comm", ev.pid);
+    int fd = open(path, O_RDONLY);
+    ssize_t n = fd >= 0 ? read(fd, buf, sizeof(buf) - 1) : 0;
+    if (fd >= 0) close(fd);
+    if (n > 0 && buf[n - 1] == '\n') n--;
+    if (n > 0) {
+      ev.key_hash = fnv1a64(buf, (size_t)n);
+      vocab_.put(ev.key_hash, buf, (size_t)n);
+      size_t c = (size_t)n < sizeof(ev.comm) - 1 ? (size_t)n : sizeof(ev.comm) - 1;
+      memcpy(ev.comm, buf, c);
+    }
+    snprintf(path, sizeof(path), "/proc/%u/ns/mnt", ev.pid);
+    char link[64];
+    ssize_t ln = readlink(path, link, sizeof(link) - 1);
+    if (ln > 0) {
+      link[ln] = 0;
+      const char* lb = strchr(link, '[');
+      if (lb) ev.mntns = strtoull(lb + 1, nullptr, 10);
+    }
+  }
+
+  std::vector<std::string> paths_;
+};
+
+}  // namespace ig
+#endif  // __linux__

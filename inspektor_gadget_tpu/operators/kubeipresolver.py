@@ -1,0 +1,94 @@
+"""KubeIPResolver operator: IP → workload-name enrichment.
+
+Reference contract: pkg/operators/kubeipresolver — a polled cluster
+inventory cache (k8sInventoryCache, kubeipresolver.go:62-156) maps event
+IPs to pod/service names for gadgets exposing KubeNetworkInformation
+(:46-59). Here the inventory backend is pluggable: a static inventory map
+(tests/agents), /etc/hosts, and — when a kube API is reachable — a
+poll hook with the same refresh cadence.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from ..gadgets.context import GadgetContext
+from ..gadgets.interface import GadgetDesc
+from ..params import ParamDesc, ParamDescs, Params
+from .operators import Operator, OperatorInstance, register
+
+REFRESH_INTERVAL = 30.0  # inventory poll cadence
+
+
+def hosts_inventory(path: str = "/etc/hosts") -> dict[str, tuple[str, str]]:
+    """ip → (kind, name) from a hosts file."""
+    out: dict[str, tuple[str, str]] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                parts = line.split()
+                if len(parts) >= 2:
+                    out[parts[0]] = ("host", parts[1])
+    except OSError:
+        pass
+    return out
+
+
+class KubeIPResolver(Operator):
+    name = "kubeipresolver"
+
+    def __init__(self, inventory_fn: Callable[[], dict] | None = None):
+        self._inventory_fn = inventory_fn or hosts_inventory
+        self._cache: dict[str, tuple[str, str]] = {}
+        self._last = 0.0
+        self._mu = threading.Lock()
+
+    def instance_params(self) -> ParamDescs:
+        return ParamDescs([
+            ParamDesc(key="resolve-ips", default="true"),
+        ])
+
+    def can_operate_on(self, desc: GadgetDesc) -> bool:
+        # applies to gadgets whose events expose address fields
+        if desc.event_cls is None:
+            return False
+        fields = {f.name for f in __import__("dataclasses").fields(desc.event_cls)}
+        return bool(fields & {"saddr", "daddr", "remote", "remoteaddr", "localaddr"})
+
+    def lookup(self, ip: str) -> tuple[str, str] | None:
+        now = time.monotonic()
+        with self._mu:
+            if now - self._last > REFRESH_INTERVAL:
+                self._cache = self._inventory_fn()
+                self._last = now
+            return self._cache.get(ip)
+
+    def set_inventory(self, inventory: dict[str, tuple[str, str]]) -> None:
+        with self._mu:
+            self._cache = dict(inventory)
+            self._last = time.monotonic()
+
+    def instantiate(self, ctx: GadgetContext, gadget: Any,
+                    instance_params: Params) -> "KubeIPResolverInstance":
+        return KubeIPResolverInstance(self, ctx)
+
+
+class KubeIPResolverInstance(OperatorInstance):
+    def __init__(self, op: KubeIPResolver, ctx: GadgetContext):
+        super().__init__(op.name)
+        self.op = op
+
+    def enrich(self, event: Any) -> None:
+        for field in ("saddr", "daddr", "remote", "remoteaddr", "localaddr"):
+            ip = getattr(event, field, None)
+            if not ip:
+                continue
+            hit = self.op.lookup(str(ip).split(":", 1)[0])
+            if hit is not None:
+                setattr(event, field, f"{ip} ({hit[0]}/{hit[1]})")
+
+
+register(KubeIPResolver())

@@ -1,0 +1,62 @@
+"""Multi-host distributed initialization + mesh topology.
+
+Reference: the distributed backend is per-node gRPC fan-out over
+kubectl-exec tunnels (SURVEY §2.5). The TPU-native backend is JAX
+collectives: jax.distributed.initialize joins every host's chips into one
+global device set; meshes then span hosts, with the 'node' axis laid out so
+its collectives ride ICI inside a pod slice and DCN only across slices
+(make_multihost_mesh orders devices slice-major for exactly that reason).
+
+Division of labor with the gRPC plane (agent/): gRPC = control (catalog,
+run lifecycle, logs, row streams for display); XLA collectives = the
+aggregation data plane (psum/pmax sketch merges, pmean grads). A cluster
+where every node has TPU chips runs merges entirely over ICI/DCN; nodes
+without chips fall back to gRPC sketch-summary streaming — same merge
+semantics (sketches are mergeable either way).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import MODEL_AXIS, NODE_AXIS
+
+
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Join the jax.distributed world (multi-host). No-op when single-host
+    or already initialized."""
+    if num_processes is None or num_processes <= 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        pass  # already initialized
+
+
+def make_multihost_mesh(n_model: int = 1) -> Mesh:
+    """Global mesh over every process's devices, slice-major so the node
+    axis's psum stays on ICI within a slice and crosses DCN once per slice
+    pair (scaling-book layout recipe)."""
+    devices = sorted(
+        jax.devices(),
+        key=lambda d: (getattr(d, "slice_index", 0) or 0, d.process_index, d.id),
+    )
+    n = len(devices) // n_model
+    mesh_devices = np.asarray(devices[: n * n_model]).reshape(n, n_model)
+    return Mesh(mesh_devices, (NODE_AXIS, MODEL_AXIS))
+
+
+def local_node_index() -> int:
+    return jax.process_index()
+
+
+def world_size() -> int:
+    return jax.process_count()

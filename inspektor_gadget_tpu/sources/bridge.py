@@ -71,6 +71,8 @@ def _load():
     lib.ig_synth_generate.restype = i64
     lib.ig_vocab_lookup.argtypes = [u64, u64, ctypes.c_char_p, i64]
     lib.ig_vocab_lookup.restype = i64
+    lib.ig_fanotify_supported.argtypes = []
+    lib.ig_fanotify_supported.restype = ctypes.c_int
     _lib = lib
     return lib
 

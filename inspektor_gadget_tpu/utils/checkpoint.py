@@ -1,0 +1,44 @@
+"""Sketch-state checkpoint/resume.
+
+The reference has no ML-style checkpointing (SURVEY §5: closest analogues
+are pinned BPF maps surviving daemon restarts and traceloop's retrospective
+rings). This framework carries real device state — sketch bundles and the
+anomaly scorer — so agents checkpoint it: host-offload the pytree, write
+one .npz (arrays) + .json (treedef/aux), resume after restart with merge
+semantics intact (a resumed bundle keeps absorbing; two checkpoints merge
+via bundle_merge exactly like live state).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+
+
+def save_pytree(path: str | Path, tree) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    np.savez_compressed(str(path.with_suffix(".npz")), **arrays)
+    path.with_suffix(".json").write_text(json.dumps({
+        "n_leaves": len(leaves),
+        "treedef": str(treedef),
+    }))
+
+
+def load_pytree(path: str | Path, like):
+    """Restore into the structure of `like` (same config/shapes)."""
+    path = Path(path)
+    with np.load(str(path.with_suffix(".npz"))) as z:
+        leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+    like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    if len(leaves) != len(like_leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves, expected {len(like_leaves)}")
+    import jax.numpy as jnp
+    restored = [jnp.asarray(a) for a in leaves]
+    return jax.tree_util.tree_unflatten(treedef, restored)

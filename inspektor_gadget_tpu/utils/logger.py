@@ -1,0 +1,53 @@
+"""Leveled logger facade (ref: pkg/logger/logger.go, 191 LoC).
+
+A thin contract over stdlib logging so components depend on the facade, not
+a backend — the role the reference's Logger interface plays over logrus.
+The gRPC transport encodes severity in the high bits of the event type
+(agent/wire.py EV_LOG_SHIFT; ref grpc-runtime.go:326-328), so remote log
+records multiplex into the event stream, and StreamLogger here is the
+server-side adapter that does that encoding.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+# severity levels mirroring the reference's (logrus) ordering
+PANIC, FATAL, ERROR, WARN, INFO, DEBUG, TRACE = range(7)
+
+_TO_STD = {
+    PANIC: logging.CRITICAL, FATAL: logging.CRITICAL, ERROR: logging.ERROR,
+    WARN: logging.WARNING, INFO: logging.INFO, DEBUG: logging.DEBUG,
+    TRACE: logging.DEBUG,
+}
+
+
+def get_logger(name: str = "ig-tpu", level: int = INFO) -> logging.Logger:
+    log = logging.getLogger(name)
+    log.setLevel(_TO_STD[level])
+    return log
+
+
+class StreamLogger:
+    """Adapter publishing log records into a gadget event stream with
+    severity-in-type encoding (ref: pkg/gadget-service/logger.go)."""
+
+    def __init__(self, push: Callable[[int, bytes], None], shift: int = 16):
+        self._push = push
+        self._shift = shift
+
+    def log(self, severity: int, msg: str) -> None:
+        self._push(severity << self._shift, msg.encode("utf-8", "replace"))
+
+    def error(self, msg: str) -> None:
+        self.log(ERROR, msg)
+
+    def warn(self, msg: str) -> None:
+        self.log(WARN, msg)
+
+    def info(self, msg: str) -> None:
+        self.log(INFO, msg)
+
+    def debug(self, msg: str) -> None:
+        self.log(DEBUG, msg)

@@ -169,3 +169,34 @@ def test_packet_sniffer_flow_edges():
         time.sleep(0.05)
     src.stop(); src.close()
     assert {9901, 9902, 9903} <= edges
+
+
+@needs_native
+def test_fanotify_watch_real_exec():
+    """fanotify exec-watch (runcfanotify analogue): watch /bin/true, exec
+    it, assert the watcher reports the exec with pid identity."""
+    import ctypes
+    import os
+    from inspektor_gadget_tpu.sources import bridge as B
+
+    lib = B._load()
+    if not lib.ig_fanotify_supported():
+        pytest.skip("fanotify unavailable")
+    os.environ["IG_FANOTIFY_PATHS"] = "/bin/true:/usr/bin/true"
+    try:
+        src = NativeCapture(102, ring_pow2=12)  # IG_SRC_FANOTIFY_EXEC
+        src.start()
+        time.sleep(0.5)
+        for _ in range(3):
+            subprocess.run(["/bin/true"], check=True)
+            time.sleep(0.1)
+        deadline = time.time() + 3.0
+        seen = 0
+        while time.time() < deadline and seen == 0:
+            b = src.pop()
+            seen += int((b.cols["kind"][:b.count] == 1).sum())
+            time.sleep(0.05)
+        src.stop(); src.close()
+        assert seen >= 1
+    finally:
+        os.environ.pop("IG_FANOTIFY_PATHS", None)

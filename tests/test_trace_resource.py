@@ -1,0 +1,80 @@
+"""Legacy CRD-path tests (model: pkg/controllers/trace_controller_test.go
+under envtest — here the reconciler runs in-process against the registry)."""
+
+import json
+import time
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.gadgets.trace_resource import (
+    OPERATION_ANNOTATION,
+    STATE_COMPLETED,
+    STATE_STARTED,
+    STATE_STOPPED,
+    TraceReconciler,
+    TraceResource,
+    TraceSpec,
+)
+
+
+def make_trace(name="t1", gadget="advise/seccomp-profile", node=""):
+    return TraceResource(
+        name=name,
+        spec=TraceSpec(node=node, gadget=gadget,
+                       parameters={"source": "pysynthetic", "rate": "20000"}),
+    )
+
+
+def test_start_generate_lifecycle():
+    r = TraceReconciler(node_name="node-a")
+    tr = make_trace()
+    tr.annotations[OPERATION_ANNOTATION] = "start"
+    r.reconcile(tr)
+    assert tr.status.state == STATE_STARTED and not tr.status.operation_error
+    assert r.active() == ["t1"]
+    time.sleep(0.5)
+    tr.annotations[OPERATION_ANNOTATION] = "generate"
+    r.reconcile(tr)
+    assert tr.status.state == STATE_COMPLETED, tr.status.operation_error
+    profiles = json.loads(tr.status.output)
+    assert profiles and "defaultAction" in next(iter(profiles.values()))
+    assert r.active() == []
+
+
+def test_stop_operation():
+    r = TraceReconciler()
+    tr = make_trace(name="t2", gadget="trace/exec")
+    tr.annotations[OPERATION_ANNOTATION] = "start"
+    r.reconcile(tr)
+    assert tr.status.state == STATE_STARTED
+    tr.annotations[OPERATION_ANNOTATION] = "stop"
+    r.reconcile(tr)
+    assert tr.status.state == STATE_STOPPED
+
+
+def test_node_filter_ignores_foreign_traces():
+    r = TraceReconciler(node_name="node-a")
+    tr = make_trace(name="t3", node="node-b")
+    tr.annotations[OPERATION_ANNOTATION] = "start"
+    r.reconcile(tr)
+    assert tr.status.state == ""  # untouched
+    assert r.active() == []
+
+
+def test_bad_operation_reports_error():
+    r = TraceReconciler()
+    tr = make_trace(name="t4")
+    tr.annotations[OPERATION_ANNOTATION] = "explode"
+    r.reconcile(tr)
+    assert "unsupported operation" in tr.status.operation_error
+
+
+def test_double_start_rejected():
+    r = TraceReconciler()
+    tr = make_trace(name="t5", gadget="trace/exec")
+    tr.annotations[OPERATION_ANNOTATION] = "start"
+    r.reconcile(tr)
+    tr.annotations[OPERATION_ANNOTATION] = "start"
+    r.reconcile(tr)
+    assert "already started" in tr.status.operation_error
+    tr.annotations[OPERATION_ANNOTATION] = "stop"
+    r.reconcile(tr)
