@@ -209,3 +209,45 @@ extern "C" int ig_fanotify_supported() {
   return 0;
 #endif
 }
+
+// ---------------------------------------------------------------------------
+// Containers map — shared mntns → container-name table.
+//
+// Reference contract: pkg/gadgettracermanager/containers-map (a BPF hash
+// map pinned at /sys/fs/bpf/gadget/containers mapping mntns → container
+// identity so BPF programs self-enrich, containers-map/tracer.go:66,119).
+// Here the table lives in the capture library; Python mirrors the
+// ContainerCollection into it and capture threads or the display path
+// resolve identity without crossing back into Python.
+// ---------------------------------------------------------------------------
+
+namespace {
+std::mutex g_cmap_mu;
+std::unordered_map<uint64_t, std::string> g_cmap;
+}  // namespace
+
+extern "C" void ig_containers_set(uint64_t mntns, const char* name,
+                                  int64_t len) {
+  std::lock_guard<std::mutex> g(g_cmap_mu);
+  g_cmap[mntns] = std::string(name, (size_t)len);
+}
+
+extern "C" void ig_containers_remove(uint64_t mntns) {
+  std::lock_guard<std::mutex> g(g_cmap_mu);
+  g_cmap.erase(mntns);
+}
+
+extern "C" int64_t ig_containers_lookup(uint64_t mntns, char* out,
+                                        int64_t cap) {
+  std::lock_guard<std::mutex> g(g_cmap_mu);
+  auto it = g_cmap.find(mntns);
+  if (it == g_cmap.end() || cap <= 0) return 0;
+  int64_t n = (int64_t)it->second.size() < cap ? (int64_t)it->second.size() : cap;
+  memcpy(out, it->second.data(), (size_t)n);
+  return n;
+}
+
+extern "C" int64_t ig_containers_count() {
+  std::lock_guard<std::mutex> g(g_cmap_mu);
+  return (int64_t)g_cmap.size();
+}

@@ -10,6 +10,7 @@ epoch-keyed all-reduce.
 """
 
 from .mesh import make_mesh, node_axis, MeshSpec
+from .ring import ring_psum, ring_psum_chunked
 from .cluster import (
     cluster_sketch_step,
     cluster_merge,
@@ -22,4 +23,5 @@ __all__ = [
     "make_mesh", "node_axis", "MeshSpec",
     "cluster_sketch_step", "cluster_merge", "make_cluster_step",
     "ClusterState", "cluster_init",
+    "ring_psum", "ring_psum_chunked",
 ]

@@ -73,8 +73,37 @@ def _load():
     lib.ig_vocab_lookup.restype = i64
     lib.ig_fanotify_supported.argtypes = []
     lib.ig_fanotify_supported.restype = ctypes.c_int
+    lib.ig_containers_set.argtypes = [u64, ctypes.c_char_p, i64]
+    lib.ig_containers_remove.argtypes = [u64]
+    lib.ig_containers_lookup.argtypes = [u64, ctypes.c_char_p, i64]
+    lib.ig_containers_lookup.restype = i64
+    lib.ig_containers_count.restype = i64
     _lib = lib
     return lib
+
+
+# -- containers map (ref: pkg/gadgettracermanager/containers-map) -----------
+
+def containers_map_set(mntns: int, name: str) -> None:
+    lib = _load()
+    if lib is not None:
+        raw = name.encode("utf-8", "replace")
+        lib.ig_containers_set(mntns, raw, len(raw))
+
+
+def containers_map_remove(mntns: int) -> None:
+    lib = _load()
+    if lib is not None:
+        lib.ig_containers_remove(mntns)
+
+
+def containers_map_lookup(mntns: int) -> str:
+    lib = _load()
+    if lib is None:
+        return ""
+    buf = ctypes.create_string_buffer(256)
+    n = lib.ig_containers_lookup(mntns, buf, 256)
+    return buf.raw[:n].decode("utf-8", "replace") if n > 0 else ""
 
 
 def native_available() -> bool:

@@ -176,3 +176,19 @@ def test_cluster_step_tp_mode():
     assert np.isfinite(float(loss))
     merged = merge(state.bundle)
     assert float(merged.events) == 4 * BATCH
+
+
+def test_ring_psum_variants_match_allreduce():
+    """Ring all-reduce (ppermute hops) and the reduce-scatter/all-gather
+    ring must equal lax.psum exactly on integer tables."""
+    from jax.sharding import PartitionSpec as P
+    from inspektor_gadget_tpu.parallel.ring import ring_psum, ring_psum_chunked
+
+    mesh = make_mesh(n_nodes=8)
+    x = jnp.arange(8 * 37, dtype=jnp.int32).reshape(8, 37)
+    want = np.broadcast_to(np.asarray(x).sum(0), (8, 37))
+    for fn in (ring_psum, ring_psum_chunked):
+        f = jax.jit(jax.shard_map(
+            lambda v: fn(v[0], "node")[None], mesh=mesh,
+            in_specs=(P("node"),), out_specs=P("node"), check_vma=False))
+        np.testing.assert_array_equal(np.asarray(f(x)), want)
