@@ -1,0 +1,93 @@
+"""End-to-end pipeline + merge-latency benchmarks (run on the TPU).
+
+Complements bench.py's headline number with the honest decomposition:
+  gen        C++ synthetic generation alone (host ceiling)
+  e2e        generate → fold32 → H2D → bundle_update, pipelined
+  merge      bundle_merge of two sketch states (the gRPC-plane merge)
+  summary    harvest → encode → decode roundtrip (the wire merge path)
+
+    python -m benchmarks.pipeline
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from inspektor_gadget_tpu.ops import bundle_init, bundle_merge, fold64_to_32
+    from inspektor_gadget_tpu.ops.sketches import bundle_update_jit
+    from inspektor_gadget_tpu.sources import PySyntheticSource
+    from inspektor_gadget_tpu.sources.bridge import (
+        NativeCapture, SRC_SYNTH_EXEC, native_available,
+    )
+
+    N = 1 << 17
+    results = {}
+
+    if native_available():
+        src = NativeCapture(SRC_SYNTH_EXEC, seed=1, vocab=5000)
+        t0 = time.perf_counter()
+        for _ in range(20):
+            src.generate(N)
+        dt = (time.perf_counter() - t0) / 20
+        results["gen_ev_per_s"] = N / dt
+    else:
+        src = PySyntheticSource(seed=1, vocab=5000, batch_size=N)
+        t0 = time.perf_counter()
+        for _ in range(20):
+            src.generate(N)
+        results["gen_ev_per_s"] = N / ((time.perf_counter() - t0) / 20)
+
+    bundle = bundle_init()
+    mask = jnp.ones(N, dtype=bool)
+
+    def step(bundle):
+        b = src.generate(N)
+        k = jnp.asarray(fold64_to_32(b.cols["key_hash"]))
+        return bundle_update_jit(bundle, k, k, k, mask)
+
+    bundle = step(bundle)
+    jax.block_until_ready(bundle.events)
+    t0 = time.perf_counter()
+    iters = 30
+    for _ in range(iters):
+        bundle = step(bundle)
+    jax.block_until_ready(bundle.events)
+    results["e2e_ev_per_s"] = N * iters / (time.perf_counter() - t0)
+
+    a, b2 = bundle, bundle_init()
+    merge_jit = __import__("jax").jit(bundle_merge)
+    m = merge_jit(a, b2)
+    jax.block_until_ready(m.events)
+    t0 = time.perf_counter()
+    for _ in range(50):
+        m = merge_jit(a, b2)
+    jax.block_until_ready(m.events)
+    results["merge_ms"] = (time.perf_counter() - t0) / 50 * 1000
+
+    # summary wire roundtrip (gRPC merge path)
+    from inspektor_gadget_tpu.agent import wire
+    from inspektor_gadget_tpu.operators.tpusketch import SketchSummary
+    s = SketchSummary(events=1, drops=0, distinct=1.0, entropy_bits=1.0,
+                      heavy_hitters=[(i, i) for i in range(128)], epoch=1)
+    t0 = time.perf_counter()
+    for _ in range(200):
+        h, payload = wire.encode_summary(s)
+        wire.decode_summary(h, payload)
+    results["summary_roundtrip_us"] = (time.perf_counter() - t0) / 200 * 1e6
+
+    state_bytes = sum(np.asarray(x).nbytes for x in jax.tree.leaves(bundle))
+    results["bundle_bytes"] = state_bytes
+    print(json.dumps({k: round(v, 1) for k, v in results.items()}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -178,6 +178,36 @@ def with_netlink_discovery():
     return opt
 
 
+def with_native_containers_map():
+    """Mirror the collection into the native containers map so the C++
+    capture layer self-enriches (ref: pkg/gadgettracermanager/containers-map
+    pinned BPF map role)."""
+
+    def opt(cc: ContainerCollection):
+        try:
+            from ..sources.bridge import (
+                containers_map_remove, containers_map_set, native_available,
+            )
+            if not native_available():
+                return
+        except Exception:
+            return
+        from .collection import EventType
+
+        def on_event(ev):
+            if ev.container.mntns:
+                if ev.type == EventType.ADD:
+                    containers_map_set(ev.container.mntns, ev.container.name)
+                else:
+                    containers_map_remove(ev.container.mntns)
+
+        for c in cc.subscribe(("native-cmap",), on_event):
+            if c.mntns:
+                containers_map_set(c.mntns, c.name)
+
+    return opt
+
+
 def with_procfs_discovery(max_pids: int = 4096):
     """Discover initial 'containers' by scanning /proc session leaders with
     distinct mount namespaces — the no-runtime-socket analogue of

@@ -8,6 +8,9 @@ vector) feeds a small autoencoder; reconstruction error is the anomaly
 score, trained online with optax — batched bf16 matmuls on the MXU.
 """
 
+from .vae import (
+    VAEScorer, VAEConfig, vae_init, vae_score, vae_train_step,
+)
 from .autoencoder import (
     AnomalyScorer,
     AEConfig,
@@ -21,4 +24,5 @@ from .autoencoder import (
 __all__ = [
     "AnomalyScorer", "AEConfig", "ae_init", "ae_apply", "ae_loss",
     "ae_train_step", "ae_score",
+    "VAEScorer", "VAEConfig", "vae_init", "vae_score", "vae_train_step",
 ]

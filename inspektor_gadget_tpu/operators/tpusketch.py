@@ -81,6 +81,9 @@ class TpuSketch(Operator):
                       description="wire column feeding entropy/anomaly"),
             ParamDesc(key="anomaly", default="false", type_hint=TypeHint.BOOL,
                       description="train the autoencoder anomaly scorer"),
+            ParamDesc(key="anomaly-model", default="ae",
+                      possible_values=("ae", "vae"),
+                      description="anomaly scorer family"),
             ParamDesc(key="harvest-interval", default="1s",
                       type_hint=TypeHint.DURATION),
         ])
@@ -112,12 +115,21 @@ class TpuSketchInstance(OperatorInstance):
             k=p.get("topk").as_int(),
         )
         self.anomaly_on = p.get("anomaly").as_bool()
+        self.anomaly_model = (p.get("anomaly-model").as_string()
+                              if "anomaly-model" in p else "ae")
         self.scorer = None
         self._container_counts: dict[int, np.ndarray] = {}
         if self.anomaly_on:
-            self._ae_cfg = AEConfig(input_dim=1 << p.get("entropy-log2-width").as_int(),
-                                    hidden_dim=256, latent_dim=64)
-            self.scorer = ae_init(self._ae_cfg)
+            dim = 1 << p.get("entropy-log2-width").as_int()
+            if self.anomaly_model == "vae":
+                from ..models.vae import VAEConfig, vae_init
+                self._ae_cfg = VAEConfig(input_dim=dim, hidden_dim=256,
+                                         latent_dim=64)
+                self.scorer = vae_init(self._ae_cfg)
+            else:
+                self._ae_cfg = AEConfig(input_dim=dim, hidden_dim=256,
+                                        latent_dim=64)
+                self.scorer = ae_init(self._ae_cfg)
         self._drops_seen = 0
         self._last_harvest = time.monotonic()
         self._epoch = 0
@@ -194,8 +206,13 @@ class TpuSketchInstance(OperatorInstance):
         if self.anomaly_on and self._container_counts:
             mats = np.stack(list(self._container_counts.values()))
             x = normalize_counts(jnp.asarray(mats))
-            self.scorer, _ = ae_train_step(self.scorer, x)
-            scores = np.asarray(ae_score(self.scorer, x))
+            if self.anomaly_model == "vae":
+                from ..models.vae import vae_score, vae_train_step
+                self.scorer, _ = vae_train_step(self.scorer, x)
+                scores = np.asarray(vae_score(self.scorer, x))
+            else:
+                self.scorer, _ = ae_train_step(self.scorer, x)
+                scores = np.asarray(ae_score(self.scorer, x))
             anomaly = {ns: float(s) for ns, s in
                        zip(self._container_counts.keys(), scores)}
         self._epoch += 1

@@ -1,0 +1,50 @@
+"""Black-box CLI tests (model: the reference's ig integration tier —
+integration/ig/* runs the built binary and matches output)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+CLI = [sys.executable, "-m", "inspektor_gadget_tpu.cli.main"]
+
+
+def run_cli(*args, timeout=120):
+    return subprocess.run(CLI + list(args), capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_cli_list_and_catalog():
+    r = run_cli("list")
+    assert r.returncode == 0
+    assert "trace" in r.stdout and "exec" in r.stdout
+    assert len(r.stdout.strip().splitlines()) >= 25
+
+    r = run_cli("catalog")
+    cat = json.loads(r.stdout)
+    assert len(cat["gadgets"]) >= 25
+    assert any(op["name"] == "tpusketch" for op in cat["operators"])
+
+
+def test_cli_trace_exec_json_output():
+    r = run_cli("trace", "exec", "--source", "pysynthetic", "--rate", "3000",
+                "--timeout", "1", "-o", "json")
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) > 10
+    row = json.loads(lines[0])
+    assert row["comm"].startswith("proc-") and row["pid"] > 0
+
+
+def test_cli_bad_param_exits_2():
+    r = run_cli("trace", "exec", "--source", "bogus", "--timeout", "1")
+    assert r.returncode == 2
+    assert "not in" in r.stderr
+
+
+def test_cli_deploy_render():
+    r = run_cli("deploy", "--render")
+    assert r.returncode == 0
+    assert "kind: DaemonSet" in r.stdout
+    assert "google.com/tpu" in r.stdout

@@ -180,3 +180,23 @@ def test_trace_exec_sketch_operator_end_to_end():
     assert last.heavy_hitters, "must surface heavy hitters"
     assert 0 < last.distinct < 2000
     assert last.entropy_bits > 0
+
+
+def test_native_containers_map_mirror():
+    from inspektor_gadget_tpu.sources.bridge import (
+        containers_map_lookup, native_available)
+    from inspektor_gadget_tpu.containers.options import with_native_containers_map
+
+    if not native_available():
+        import pytest as _pytest
+        _pytest.skip("no native lib")
+    cc = ContainerCollection()
+    cc.initialize(
+        with_fake_containers([Container(id="nm1", name="webby", mntns=777123)]),
+        with_native_containers_map(),
+    )
+    assert containers_map_lookup(777123) == "webby"
+    cc.add_container(Container(id="nm2", name="dbby", mntns=777124))
+    assert containers_map_lookup(777124) == "dbby"
+    cc.remove_container("nm2")
+    assert containers_map_lookup(777124) == ""

@@ -192,3 +192,21 @@ def test_ring_psum_variants_match_allreduce():
             lambda v: fn(v[0], "node")[None], mesh=mesh,
             in_specs=(P("node"),), out_specs=P("node"), check_vma=False))
         np.testing.assert_array_equal(np.asarray(f(x)), want)
+
+
+def test_vae_trains_and_scores_anomalies():
+    from inspektor_gadget_tpu.models import VAEConfig, vae_init, vae_score, vae_train_step
+
+    cfg = VAEConfig(input_dim=DIM, hidden_dim=128, latent_dim=16,
+                    compute_dtype=jnp.float32)
+    scorer = vae_init(cfg, seed=1)
+    rng = np.random.default_rng(0)
+    x = normalize_counts(jnp.asarray(rng.poisson(5.0, (64, DIM)).astype(np.float32)))
+    losses = []
+    for _ in range(30):
+        scorer, loss = vae_train_step(scorer, x)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    normal = float(vae_score(scorer, x).mean())
+    weird = jnp.zeros((4, DIM), jnp.float32).at[:, 5].set(1.0)
+    assert float(vae_score(scorer, weird).mean()) > normal
