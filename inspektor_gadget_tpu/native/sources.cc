@@ -123,14 +123,33 @@ class SyntheticSource : public Source {
         rate_(rate_per_sec),
         vocab_size_(vocab_size ? vocab_size : 1000),
         zipf_s_(zipf_s > 0 ? zipf_s : 1.2) {
-    // Precompute the zipf CDF once; draw via binary search.
-    cdf_.resize(vocab_size_);
+    // Zipf sampling via Walker's alias method: O(1) per draw (one random,
+    // one table probe) instead of a CDF binary search — keeps the host
+    // generation path well above the device-feed requirement.
+    std::vector<double> p(vocab_size_);
     double sum = 0;
     for (uint32_t i = 0; i < vocab_size_; i++) {
-      sum += 1.0 / std::pow((double)(i + 1), zipf_s_);
-      cdf_[i] = sum;
+      p[i] = 1.0 / std::pow((double)(i + 1), zipf_s_);
+      sum += p[i];
     }
-    for (auto& c : cdf_) c /= sum;
+    alias_prob_.resize(vocab_size_);
+    alias_idx_.resize(vocab_size_);
+    std::vector<uint32_t> small, large;
+    std::vector<double> scaled(vocab_size_);
+    for (uint32_t i = 0; i < vocab_size_; i++) {
+      scaled[i] = p[i] / sum * vocab_size_;
+      (scaled[i] < 1.0 ? small : large).push_back(i);
+    }
+    while (!small.empty() && !large.empty()) {
+      uint32_t s = small.back(); small.pop_back();
+      uint32_t l = large.back(); large.pop_back();
+      alias_prob_[s] = scaled[s];
+      alias_idx_[s] = l;
+      scaled[l] = scaled[l] + scaled[s] - 1.0;
+      (scaled[l] < 1.0 ? small : large).push_back(l);
+    }
+    for (uint32_t i : small) { alias_prob_[i] = 1.0; alias_idx_[i] = i; }
+    for (uint32_t i : large) { alias_prob_[i] = 1.0; alias_idx_[i] = i; }
     names_.reserve(vocab_size_);
     for (uint32_t i = 0; i < vocab_size_; i++) {
       char buf[24];
@@ -145,8 +164,10 @@ class SyntheticSource : public Source {
   ~SyntheticSource() override { stop(); }
 
   // Fill a caller buffer directly — the zero-copy bench path (no thread).
+  // One clock read per batch: the bridge stamps batch-level timestamps.
   size_t generate(Event* out, size_t n) {
-    for (size_t i = 0; i < n; i++) out[i] = make_event();
+    uint64_t ts = now_ns();
+    for (size_t i = 0; i < n; i++) out[i] = make_event(ts);
     return n;
   }
 
@@ -173,15 +194,16 @@ class SyntheticSource : public Source {
   }
 
   uint32_t zipf_draw() {
-    double u = (double)(next_rand() >> 11) * (1.0 / 9007199254740992.0);
-    auto it = std::lower_bound(cdf_.begin(), cdf_.end(), u);
-    return (uint32_t)(it - cdf_.begin());
+    uint64_t r = next_rand();
+    uint32_t i = (uint32_t)((r >> 32) % vocab_size_);
+    double u = (double)(r & 0xFFFFFFFF) * (1.0 / 4294967296.0);
+    return u < alias_prob_[i] ? i : alias_idx_[i];
   }
 
-  Event make_event() {
+  Event make_event(uint64_t ts = 0) {
     Event ev{};
     uint32_t idx = zipf_draw();
-    ev.ts_ns = now_ns();
+    ev.ts_ns = ts ? ts : now_ns();
     ev.key_hash = hashes_[idx];
     ev.pid = 1000 + (uint32_t)(next_rand() % 50000);
     ev.ppid = 1;
@@ -201,7 +223,8 @@ class SyntheticSource : public Source {
   double rate_;
   uint32_t vocab_size_;
   double zipf_s_;
-  std::vector<double> cdf_;
+  std::vector<double> alias_prob_;
+  std::vector<uint32_t> alias_idx_;
   std::vector<std::string> names_;
   std::vector<uint64_t> hashes_;
 };
