@@ -1,0 +1,38 @@
+"""Example: library-level use of the sketch plane (the role of the
+reference's examples/ directory — embedding the framework without the CLI).
+
+Run: python examples/sketch_pipeline.py
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from inspektor_gadget_tpu.ops import (
+    bundle_init, fold64_to_32, hll_estimate, entropy_estimate, topk_values,
+)
+from inspektor_gadget_tpu.ops.sketches import bundle_update_jit
+from inspektor_gadget_tpu.sources import PySyntheticSource
+
+
+def main():
+    src = PySyntheticSource(seed=7, vocab=2000, batch_size=8192)
+    bundle = bundle_init()
+    for _ in range(20):
+        batch = src.generate()
+        keys = jnp.asarray(fold64_to_32(batch.cols["key_hash"]))
+        mask = jnp.ones(batch.count, bool)
+        bundle = bundle_update_jit(bundle, keys, keys, keys, mask)
+
+    print(f"events:   {float(bundle.events):,.0f}")
+    print(f"distinct: {float(hll_estimate(bundle.hll)):,.1f}")
+    print(f"entropy:  {float(entropy_estimate(bundle.entropy)):.2f} bits")
+    keys, counts = topk_values(bundle.topk)
+    order = np.argsort(-np.asarray(counts))[:5]
+    print("top-5 heavy hitters:")
+    for i in order:
+        name = src.vocab_lookup(int(np.asarray(keys)[i])) or hex(int(keys[i]))
+        print(f"  {name:12s} ~{int(counts[i]):,}")
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,7 @@ def encode_summary(summary) -> tuple[dict, bytes]:
         "distinct": summary.distinct, "entropy": summary.entropy_bits,
         "epoch": summary.epoch,
         "anomaly": summary.anomaly,
+        "names": {str(k): v for k, v in (summary.names or {}).items()},
     }
     arr = np.asarray(summary.heavy_hitters, dtype=np.int64)
     buf = io.BytesIO()
@@ -76,6 +77,7 @@ def decode_summary(header: dict, payload: bytes) -> dict:
     hh = np.load(io.BytesIO(payload)) if payload else np.zeros((0, 2), np.int64)
     out = dict(header)
     out["heavy_hitters"] = [(int(k), int(c)) for k, c in hh]
+    out["names"] = {int(k): v for k, v in (header.get("names") or {}).items()}
     return out
 
 

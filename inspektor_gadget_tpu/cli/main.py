@@ -160,11 +160,33 @@ def cmd_run(args) -> int:
                     return 2
         op_params[prefix] = params
 
+    extra = {}
+    sketch_on = False
+    if "operator.tpusketch." in op_params:
+        sp = op_params["operator.tpusketch."]
+        sketch_on = "enable" in sp and sp.get("enable").as_bool()
+    if sketch_on:
+        def print_summary(s):
+            sys.stdout.write(
+                f"\n— sketch epoch {s.epoch}: events={s.events:,} "
+                f"distinct≈{s.distinct:,.0f} entropy={s.entropy_bits:.2f}b "
+                f"drops={s.drops}\n")
+            for key32, count in s.heavy_hitters[:10]:
+                label = s.names.get(key32, f"0x{key32:08x}")
+                sys.stdout.write(f"  {label:<24s}  {count:>10,}\n")
+            if s.anomaly:
+                worst = sorted(s.anomaly.items(), key=lambda kv: -kv[1])[:5]
+                for ns, score in worst:
+                    sys.stdout.write(f"  anomaly mntns={ns}: {score:.4f}\n")
+            sys.stdout.flush()
+        extra["on_sketch_summary"] = print_summary
+
     ctx = GadgetContext(
         desc,
         gadget_params=gadget_params,
         operator_params=op_params,
         timeout=args.timeout,
+        extra=extra,
     )
 
     if args.remote:

@@ -54,6 +54,7 @@ class SketchSummary:
     heavy_hitters: list[tuple[int, int]]  # (key32, est count)
     anomaly: dict[int, float] | None = None  # mntns-slot → score
     epoch: int = 0
+    names: dict[int, str] = dataclasses.field(default_factory=dict)  # key32 → label
 
 
 class TpuSketch(Operator):
@@ -133,6 +134,7 @@ class TpuSketchInstance(OperatorInstance):
         self._drops_seen = 0
         self._last_harvest = time.monotonic()
         self._epoch = 0
+        self._names: dict[int, str] = {}
         self.on_summary: Callable[[SketchSummary], None] | None = ctx.extra.get(
             "on_sketch_summary")
         self._pad = 8192  # fixed device batch shape (pad/mask)
@@ -176,6 +178,18 @@ class TpuSketchInstance(OperatorInstance):
         self._stats.steps += 1
         self._stats.events += n
         self._stats.drops = batch.drops
+        # label sampling: heavy keys recur in nearly every batch, so a small
+        # per-batch sample builds the key32 → name table without touching
+        # the hot path measurably
+        raw = batch.cols[self.hh_col]
+        resolve = getattr(self.gadget, "resolve_key", None)
+        for i in range(min(n, 32)):
+            k32 = int(hh[i])
+            if k32 and k32 not in self._names:
+                name = ""
+                if resolve is not None and raw.dtype == np.uint64:
+                    name = resolve(int(raw[i]))
+                self._names[k32] = name or batch.comm_str(i) or f"0x{k32:08x}"
         if self.anomaly_on:
             self._accumulate_container_dists(batch, n)
         now = time.monotonic()
@@ -224,6 +238,7 @@ class TpuSketchInstance(OperatorInstance):
             heavy_hitters=hh,
             anomaly=anomaly,
             epoch=self._epoch,
+            names={k: self._names[k] for k, _ in hh if k in self._names},
         )
         if self.on_summary is not None:
             self.on_summary(summary)

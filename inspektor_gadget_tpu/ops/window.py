@@ -1,0 +1,91 @@
+"""Sliding-window sketches — time-decayed analytics on device.
+
+The reference's interval machinery keeps only the latest snapshot per node
+with a TTL (pkg/snapshotcombiner: entries age out after N ticks without
+refresh), and top gadgets reset their stats map every interval. The
+TPU-native generalization: a ring of S epoch slots per sketch; updates land
+in the current slot, a query sums the most recent k slots ("heavy hitters
+over the last k intervals"), and advancing the epoch zeroes the oldest slot
+— all static shapes, one jitted step, mergeable across nodes slot-wise.
+
+This is also the long-sequence story: an unbounded event sequence becomes a
+rotating window of bounded per-epoch summaries, the streaming analogue of
+blockwise/context-parallel attention windows.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from .hashing import row_hashes
+
+
+@flax.struct.dataclass
+class WindowedCMS:
+    slots: jnp.ndarray   # (S, depth, width) int32 — epoch ring of CM tables
+    epoch: jnp.ndarray   # () int32 — current slot index
+    log2_width: int = flax.struct.field(pytree_node=False)
+
+    @property
+    def n_slots(self) -> int:
+        return self.slots.shape[0]
+
+    @property
+    def depth(self) -> int:
+        return self.slots.shape[1]
+
+
+def wcms_init(n_slots: int = 8, depth: int = 4, log2_width: int = 14) -> WindowedCMS:
+    return WindowedCMS(
+        slots=jnp.zeros((n_slots, depth, 1 << log2_width), jnp.int32),
+        epoch=jnp.zeros((), jnp.int32),
+        log2_width=log2_width,
+    )
+
+
+def wcms_update(state: WindowedCMS, keys: jnp.ndarray,
+                weights: jnp.ndarray | None = None) -> WindowedCMS:
+    """Scatter-add the batch into the current epoch slot."""
+    if weights is None:
+        weights = jnp.ones(keys.shape, jnp.int32)
+    idx = row_hashes(keys, state.depth, state.log2_width)  # (depth, n)
+    rows = jnp.broadcast_to(
+        jnp.arange(state.depth, dtype=jnp.int32)[:, None], idx.shape)
+    slot = jnp.broadcast_to(state.epoch, idx.shape)
+    slots = state.slots.at[
+        slot.reshape(-1), rows.reshape(-1), idx.reshape(-1)
+    ].add(jnp.tile(weights.astype(jnp.int32), (state.depth,)))
+    return state.replace(slots=slots)
+
+
+def wcms_advance(state: WindowedCMS) -> WindowedCMS:
+    """Rotate: move to the next slot and zero it (drop the oldest epoch)."""
+    nxt = (state.epoch + 1) % state.n_slots
+    slots = state.slots.at[nxt].set(0)
+    return state.replace(slots=slots, epoch=nxt)
+
+
+def wcms_query(state: WindowedCMS, keys: jnp.ndarray,
+               last_k: int | None = None) -> jnp.ndarray:
+    """Count estimate over the most recent `last_k` epochs (default: all
+    live slots). Static `last_k` keeps the executable shape-stable."""
+    k = state.n_slots if last_k is None else min(last_k, state.n_slots)
+    # slot indices of the last k epochs, newest first
+    offsets = jnp.arange(k, dtype=jnp.int32)
+    live = (state.epoch - offsets) % state.n_slots          # (k,)
+    table = state.slots[live].sum(axis=0)                   # (depth, width)
+    idx = row_hashes(keys, state.depth, state.log2_width)
+    gathered = jnp.stack([table[d, idx[d]] for d in range(state.depth)])
+    return gathered.min(axis=0)
+
+
+def wcms_merge(a: WindowedCMS, b: WindowedCMS) -> WindowedCMS:
+    """Slot-wise merge (epochs must be aligned across nodes — the cluster
+    step advances all nodes' epochs together)."""
+    return a.replace(slots=a.slots + b.slots)
+
+
+def wcms_psum(state: WindowedCMS, axis_name: str) -> WindowedCMS:
+    return state.replace(slots=jax.lax.psum(state.slots, axis_name))

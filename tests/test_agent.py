@@ -173,3 +173,38 @@ def test_snapshot_combiner_ttl():
     assert "c2" in out and "a" in out
     out = c.get_snapshots()
     assert out == ["c2"] or out == []  # node-0 aged out
+
+
+def test_node_failure_isolated(agents):
+    """Kill one agent mid-run: its node reports an error, others stream on
+    (ref: CombinedGadgetResult partial results, runtime.go:42-79)."""
+    import tempfile
+    from inspektor_gadget_tpu.agent.service import serve as serve_agent
+    from inspektor_gadget_tpu.runtime import GrpcRuntime
+
+    tmp = tempfile.mkdtemp()
+    addr = f"unix://{tmp}/doomed.sock"
+    doomed_server, _ = serve_agent(addr, node_name="doomed")
+    targets = dict(agents)
+    targets["doomed"] = addr
+
+    desc = get("trace", "exec")
+    params = desc.params().to_params()
+    params.set("source", "pysynthetic")
+    params.set("rate", "3000")
+    ctx = GadgetContext(desc, gadget_params=params, timeout=2.0)
+    runtime = GrpcRuntime(targets)
+    events = []
+
+    def killer():
+        time.sleep(0.6)
+        doomed_server.stop(grace=0)
+
+    threading.Thread(target=killer, daemon=True).start()
+    result = runtime.run_gadget(ctx, on_event=events.append)
+    runtime.close()
+    healthy = {"node-0", "node-1", "node-2"}
+    assert healthy <= set(result.keys())
+    for n in healthy:
+        assert result[n].error is None, result[n].error
+    assert {e.node for e in events} >= healthy

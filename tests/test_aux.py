@@ -149,3 +149,32 @@ def test_kubemanager_selector_filtering():
     inst.post_gadget_run()
     lm.cc.remove_container("km1")
     lm.cc.remove_container("km2")
+
+
+def test_dnstester_builds_valid_query():
+    from tools.dnstester import build_query
+
+    pkt = build_query("a.example.com", qtype=28)
+    assert pkt[:2] == b"\x12\x34"
+    assert b"\x01a\x07example\x03com\x00" in pkt
+    assert pkt.endswith(b"\x00\x1c\x00\x01")  # AAAA, IN
+
+
+def test_runtime_client_detection_degrades():
+    from inspektor_gadget_tpu.containers.runtime_client import (
+        DockerClient, detect_runtime_client, with_runtime_enrichment)
+    from inspektor_gadget_tpu.containers import ContainerCollection
+
+    # no docker socket in this environment → probe must degrade cleanly
+    assert DockerClient("/nonexistent.sock").available() is False
+    detect_runtime_client()  # must not raise
+    cc = ContainerCollection()
+    cc.initialize(with_runtime_enrichment())  # silent no-op
+    assert len(cc) >= 0
+
+
+def test_windowed_example_scripts_importable():
+    import examples.sketch_pipeline
+    import examples.custom_gadget  # registers trace/heartbeat
+    from inspektor_gadget_tpu.gadgets import get
+    assert get("trace", "heartbeat").description

@@ -191,3 +191,43 @@ def test_bundle_update_jit_donation():
     b = bundle_update_jit(b, keys, keys, keys, mask)
     b = bundle_update_jit(b, keys, keys, keys, mask)
     assert float(b.events) == 512
+
+
+# -- sliding window (TTL semantics on device) --------------------------------
+
+def test_windowed_cms_ttl_semantics():
+    from inspektor_gadget_tpu.ops.window import (
+        wcms_advance, wcms_init, wcms_query, wcms_update)
+
+    w = wcms_init(n_slots=3, depth=4, log2_width=10)
+    k7 = jnp.array([7, 7], dtype=jnp.uint32)
+    k9 = jnp.array([9], dtype=jnp.uint32)
+    w = wcms_update(w, k7)          # epoch 0: 7 -> 2
+    w = wcms_advance(w)
+    w = wcms_update(w, k9)          # epoch 1: 9 -> 1
+    q = wcms_query(w, jnp.array([7, 9], dtype=jnp.uint32))
+    assert q[0] == 2 and q[1] == 1  # both epochs live
+    # only last 1 epoch: 7 aged out of scope
+    q1 = wcms_query(w, jnp.array([7, 9], dtype=jnp.uint32), last_k=1)
+    assert q1[0] == 0 and q1[1] == 1
+    # rotate twice more: epoch-0 slot is dropped entirely
+    w = wcms_advance(w)
+    w = wcms_advance(w)             # wraps onto slot 0, zeroing it
+    q = wcms_query(w, jnp.array([7], dtype=jnp.uint32))
+    assert q[0] == 0
+
+
+def test_windowed_cms_merge_and_jit():
+    import jax as _jax
+    from inspektor_gadget_tpu.ops.window import (
+        wcms_init, wcms_merge, wcms_query, wcms_update)
+
+    a = wcms_init(n_slots=2, depth=4, log2_width=10)
+    b = wcms_init(n_slots=2, depth=4, log2_width=10)
+    keys = jnp.array([5, 5, 6], dtype=jnp.uint32)
+    upd = _jax.jit(wcms_update)
+    a = upd(a, keys)
+    b = upd(b, keys)
+    m = wcms_merge(a, b)
+    q = wcms_query(m, jnp.array([5, 6], dtype=jnp.uint32))
+    assert q[0] == 4 and q[1] == 2

@@ -1,0 +1,51 @@
+"""dnstester — deterministic DNS traffic generator for tests/integration.
+
+Reference contract: tools/dnstester/dnstester.go — a container the
+integration suite queries so trace/dns has deterministic traffic. Here the
+generator crafts raw DNS queries (optionally at a fixed rate) toward a
+target; the AF_PACKET sniffer sees them on lo without any server.
+
+    python -m tools.dnstester --qname foo.example.com --count 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import struct
+import time
+
+
+def build_query(qname: str, qtype: int = 1, txid: int = 0x1234) -> bytes:
+    header = struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 0)
+    q = b""
+    for label in qname.strip(".").split("."):
+        raw = label.encode()
+        q += bytes([len(raw)]) + raw
+    q += b"\x00" + struct.pack(">HH", qtype, 1)
+    return header + q
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--qname", default="tester.example.com")
+    ap.add_argument("--target", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=53)
+    ap.add_argument("--count", type=int, default=10)
+    ap.add_argument("--rate", type=float, default=50.0, help="queries/sec")
+    args = ap.parse_args(argv)
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    pkt = build_query(args.qname)
+    for i in range(args.count):
+        s.sendto(pkt, (args.target, args.port))
+        if args.rate > 0:
+            time.sleep(1.0 / args.rate)
+    s.close()
+    print(f"sent {args.count} queries for {args.qname!r} to "
+          f"{args.target}:{args.port}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
