@@ -84,6 +84,8 @@ class AgentServer:
             timeout=float(run.get("timeout") or 0),
             run_id=run.get("run_id") or None,
         )
+        # run-with-result gadgets render server-side in the requested format
+        ctx.extra["output"] = "json" if "result-json" in outputs else "columns"
         with self._runs_mu:
             self._runs[ctx.run_id] = ctx
 
@@ -150,12 +152,25 @@ class AgentServer:
                     ctx,
                     on_event=on_event if desc.gadget_type == GadgetType.TRACE else None,
                     on_event_array=on_event_array
-                    if desc.gadget_type == GadgetType.TRACE_INTERVALS else None,
+                    if (desc.gadget_type == GadgetType.TRACE_INTERVALS
+                        or (desc.gadget_type == GadgetType.ONE_SHOT
+                            and "combiner" in outputs)) else None,
                     on_batch=on_batch,
                 )
                 result_holder["result"] = res
             finally:
-                out_q.put(None)  # sentinel
+                # sentinel must never block: a full queue with a gone client
+                # would leak this thread — make room, then mark end-of-stream
+                while True:
+                    try:
+                        out_q.put_nowait(None)
+                        break
+                    except queue.Full:
+                        try:
+                            out_q.get_nowait()
+                            dropped[0] += 1
+                        except queue.Empty:
+                            pass
 
         t = threading.Thread(target=run_thread, daemon=True)
         t.start()

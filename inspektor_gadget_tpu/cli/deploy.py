@@ -89,18 +89,95 @@ spec:
 """
 
 
+STATE_FILE = "/tmp/ig-tpu-agents.json"
+
+
+def _alive(pid: int) -> bool:
+    import os
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
 def deploy_local(n: int, base_port: int = 50151) -> dict[str, str]:
     """Start n local agent daemons (subprocesses); returns node→target."""
+    import json
     import subprocess
     import sys
 
+    # refuse to orphan a live fleet: a second deploy would fail port-bind
+    # and overwrite the only record of the running agents
+    try:
+        with open(STATE_FILE) as f:
+            old = json.load(f)
+        if any(_alive(p) for p in old.get("pids", {}).values()):
+            raise RuntimeError(
+                "a local agent fleet is already running — "
+                "`ig-tpu undeploy` it first")
+    except (OSError, ValueError):
+        pass
+
     targets = {}
+    pids = {}
     for i in range(n):
         port = base_port + i
-        subprocess.Popen(
+        p = subprocess.Popen(
             [sys.executable, "-m", "inspektor_gadget_tpu.agent.main", "serve",
              "--listen", f"127.0.0.1:{port}", "--node-name", f"node-{i}"],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
         targets[f"node-{i}"] = f"127.0.0.1:{port}"
+        pids[f"node-{i}"] = p.pid
+    with open(STATE_FILE, "w") as f:
+        json.dump({"targets": targets, "pids": pids}, f)
     return targets
+
+
+def local_targets() -> dict[str, str]:
+    import json
+    try:
+        with open(STATE_FILE) as f:
+            return json.load(f)["targets"]
+    except (OSError, ValueError, KeyError):
+        return {}
+
+
+def undeploy_local() -> list[str]:
+    """Stop agents started by deploy_local (ref: undeploy.go removes the
+    DaemonSet + RBAC; here we terminate the local fleet)."""
+    import json
+    import os
+    import signal as _signal
+
+    stopped = []
+    try:
+        with open(STATE_FILE) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        return stopped
+    for node, pid in state.get("pids", {}).items():
+        try:
+            os.kill(pid, _signal.SIGTERM)
+            stopped.append(node)
+        except OSError:  # dead pid, or recycled pid owned by someone else
+            pass
+    try:
+        os.unlink(STATE_FILE)
+    except OSError:
+        pass
+    return stopped
+
+
+def render_undeploy(namespace: str = NAMESPACE) -> str:
+    """Deletion list for kubectl delete -f (undeploy.go:1-254 analogue)."""
+    return (
+        f"# kubectl delete -f - <<EOF\n"
+        f"apiVersion: v1\nkind: Namespace\nmetadata:\n  name: {namespace}\n"
+        f"---\napiVersion: rbac.authorization.k8s.io/v1\nkind: ClusterRole\n"
+        f"metadata:\n  name: ig-tpu-agent\n"
+        f"---\napiVersion: rbac.authorization.k8s.io/v1\n"
+        f"kind: ClusterRoleBinding\nmetadata:\n  name: ig-tpu-agent\n"
+        f"# EOF\n"
+    )

@@ -15,7 +15,25 @@ import json
 import signal
 import sys
 
-from .. import all_gadgets  # noqa: F401 — registers everything
+# a Ctrl-C during the (slow, jax-importing) startup must not dump a
+# KeyboardInterrupt traceback: remember it, finish loading, exit cleanly.
+# Only armed when this module IS the program (python -m …cli.main) — a
+# library import must not hijack the host process's SIGINT handling.
+_early_interrupt = False
+_prev_sigint = None
+
+
+def _early_sigint(signum, frame):
+    global _early_interrupt
+    _early_interrupt = True
+
+
+if __name__ == "__main__":
+    import threading as _threading
+    if _threading.current_thread() is _threading.main_thread():
+        _prev_sigint = signal.signal(signal.SIGINT, _early_sigint)
+
+from .. import all_gadgets  # noqa: F401,E402 — registers everything
 from ..columns import TextFormatter, parse_filters, match_event, parse_sort, sort_events
 from ..gadgets import GadgetContext, registry_clear  # noqa: F401
 from ..gadgets import registry as gadget_registry
@@ -44,6 +62,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="start N local agent daemons")
     dp.add_argument("--image", default="")
     dp.set_defaults(func=cmd_deploy)
+
+    up = sub.add_parser("undeploy", help="stop local agents / render deletion")
+    up.add_argument("--render", action="store_true",
+                    help="print kubectl deletion manifest list")
+    up.set_defaults(func=cmd_undeploy)
+
+    bp = sub.add_parser("debug", help="dump agent state (DumpState analogue)")
+    bp.add_argument("--remote", default="",
+                    help="name=target[,...]; defaults to the local fleet")
+    bp.set_defaults(func=cmd_debug)
 
     vp = sub.add_parser("version", help="print version")
     vp.set_defaults(func=lambda a: (print(_version()), 0)[1])
@@ -120,12 +148,65 @@ def cmd_deploy(args) -> int:
         print(render_manifests(image=args.image or AGENT_IMAGE))
         return 0
     if args.local > 0:
-        targets = deploy_local(args.local)
+        try:
+            targets = deploy_local(args.local)
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         spec = ",".join(f"{k}={v}" for k, v in targets.items())
         print(f"started {args.local} agents; use: --remote {spec}")
         return 0
     print("use --render or --local N", file=sys.stderr)
     return 2
+
+
+def parse_targets(spec: str) -> dict[str, str]:
+    """Parse 'name=host:port[,name=host:port...]' with a usage error on
+    malformed input (shared by --remote run/debug)."""
+    targets = {}
+    for kv in spec.split(","):
+        if "=" not in kv:
+            raise ParamError(
+                f"bad --remote entry {kv!r}: expected name=host:port")
+        name, target = kv.split("=", 1)
+        targets[name] = target
+    return targets
+
+
+def cmd_undeploy(args) -> int:
+    from .deploy import render_undeploy, undeploy_local
+    if args.render:
+        print(render_undeploy())
+        return 0
+    stopped = undeploy_local()
+    print(f"stopped {len(stopped)} agents" + (f": {', '.join(stopped)}"
+                                              if stopped else ""))
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """ref: `kubectl-gadget debug` + DumpState RPC
+    (gadgettracermanager.go:204-219, cmd/kubectl-gadget/debug.go)."""
+    from ..agent.client import AgentClient
+    from .deploy import local_targets
+    try:
+        targets = parse_targets(args.remote) if args.remote else local_targets()
+    except ParamError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not targets:
+        print("no agents (use deploy --local N or --remote)", file=sys.stderr)
+        return 2
+    rc = 0
+    for node, target in targets.items():
+        try:
+            state = AgentClient(target).dump_state()
+            print(f"=== {node} ({target}) ===")
+            print(json.dumps(state, indent=2, default=str))
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            print(f"=== {node} ({target}) === error: {e}", file=sys.stderr)
+            rc = 1
+    return rc
 
 
 def cmd_run(args) -> int:
@@ -181,6 +262,7 @@ def cmd_run(args) -> int:
             sys.stdout.flush()
         extra["on_sketch_summary"] = print_summary
 
+    extra["output"] = args.output
     ctx = GadgetContext(
         desc,
         gadget_params=gadget_params,
@@ -224,7 +306,8 @@ def cmd_run(args) -> int:
         rows = [e for e in evs if not filters or match_event(e, filters, cols)]
         if args.sort:
             rows = sort_events(rows, parse_sort(args.sort, cols), cols)
-        rows = rows[: args.max_rows]
+        if desc.gadget_type == GadgetType.TRACE_INTERVALS:
+            rows = rows[: args.max_rows]  # top-gadget truncation only
         if args.output == "json":
             out.write(json.dumps([cols.to_dict(e) for e in rows], default=str) + "\n")
         else:
@@ -238,7 +321,11 @@ def cmd_run(args) -> int:
 
     if args.remote:
         from ..runtime.grpc_runtime import GrpcRuntime
-        targets = dict(kv.split("=", 1) for kv in args.remote.split(","))
+        try:
+            targets = parse_targets(args.remote)
+        except ParamError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         runtime = GrpcRuntime(targets)
         if args.node:
             ctx.runtime_params = runtime.params().to_params()
@@ -255,7 +342,8 @@ def cmd_run(args) -> int:
         ctx,
         on_event=on_event if desc.gadget_type in (GadgetType.TRACE,) else None,
         on_event_array=on_event_array
-        if desc.gadget_type == GadgetType.TRACE_INTERVALS else None,
+        if desc.gadget_type in (GadgetType.TRACE_INTERVALS, GadgetType.ONE_SHOT)
+        else None,
     )
     errs = result.errors()
     if errs:
@@ -272,6 +360,10 @@ def cmd_run(args) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if _early_interrupt:
+        return 0
+    if _prev_sigint is not None:
+        signal.signal(signal.SIGINT, _prev_sigint)
     ap = build_parser()
     args = ap.parse_args(argv)
     if not hasattr(args, "func"):

@@ -106,11 +106,11 @@ class ProfileCpu:
             dt = time.monotonic() - t0
             if ctx.sleep_or_done(max(period - dt, 0)):
                 break
-        return self._render(stacks)
+        return self._render(ctx, stacks)
 
     run = run_with_result
 
-    def _render(self, stacks) -> bytes:
+    def _render(self, ctx, stacks) -> bytes:
         if self.fmt == "folded":
             # flamegraph-compatible: root..leaf, semicolon-joined
             lines = []
@@ -121,12 +121,13 @@ class ProfileCpu:
         agg: Counter[str] = Counter()
         for (who, _frames), n in stacks.items():
             agg[who.rsplit(":", 1)[0]] += n
-        from ...columns import Columns, TextFormatter
+        from ...columns import Columns
+        from ..render import render_result
         rows = [CpuSample(comm=comm, samples=n)
                 for comm, n in agg.most_common(50)]
         cols = Columns(CpuSample)
         cols.hide_tagged(["kubernetes"])
-        return TextFormatter(cols).format_table(rows).encode()
+        return render_result(ctx, rows, cols)
 
 
 @register

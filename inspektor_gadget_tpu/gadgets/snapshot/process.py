@@ -61,15 +61,23 @@ class SnapshotProcess:
         self.show_threads = (p.get("threads").as_bool()
                              if "threads" in p else False)
         self._mntns_filter: set[int] | None = None
+        self._array_handler = None
 
     def set_mntns_filter(self, mntns_ids: set[int] | None) -> None:
         self._mntns_filter = mntns_ids
 
+    def set_event_handler_array(self, handler) -> None:
+        # one-shot gadgets deliver events through the combiner path
+        # (ref: parser.EnableCombiner, grpc-runtime.go:204-207)
+        self._array_handler = handler
+
     def run_with_result(self, ctx) -> bytes:
         ctx.result = self.collect()
-        cols = ctx.columns
-        from ...columns import TextFormatter
-        return TextFormatter(cols).format_table(ctx.result).encode()
+        if self._array_handler is not None:
+            self._array_handler(ctx.result)
+            return b""
+        from ..render import render_result
+        return render_result(ctx, ctx.result)
 
     def run(self, ctx) -> None:
         self.run_with_result(ctx)

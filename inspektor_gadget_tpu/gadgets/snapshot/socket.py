@@ -83,6 +83,10 @@ class SnapshotSocket:
     def __init__(self, ctx):
         p = ctx.gadget_params
         self.proto = p.get("proto").as_string() if "proto" in p else "all"
+        self._array_handler = None
+
+    def set_event_handler_array(self, handler) -> None:
+        self._array_handler = handler
 
     def run_with_result(self, ctx) -> bytes:
         rows: list[SocketEvent] = []
@@ -93,8 +97,11 @@ class SnapshotSocket:
             rows += _parse("/proc/net/udp", "udp", False)
             rows += _parse("/proc/net/udp6", "udp", True)
         ctx.result = rows
-        from ...columns import TextFormatter
-        return TextFormatter(ctx.columns).format_table(rows).encode()
+        if self._array_handler is not None:
+            self._array_handler(rows)
+            return b""
+        from ..render import render_result
+        return render_result(ctx, rows)
 
     def run(self, ctx) -> None:
         self.run_with_result(ctx)

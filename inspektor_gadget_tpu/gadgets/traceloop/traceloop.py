@@ -19,7 +19,7 @@ from collections import deque
 
 import numpy as np
 
-from ...columns import Columns, TextFormatter, col
+from ...columns import Columns, col
 from ...params import ParamDesc, ParamDescs, TypeHint
 from ...types import Event, WithMountNsID
 from ..interface import Attacher, GadgetDesc, GadgetType
@@ -106,7 +106,8 @@ class Traceloop(SourceTraceGadget):
         records = self.read()
         cols = Columns(SyscallRecord)
         cols.hide_tagged(["kubernetes"])
-        return TextFormatter(cols).format_table(records[-200:]).encode()
+        from ..render import render_result
+        return render_result(ctx, records[-200:], cols)
 
 
 @register

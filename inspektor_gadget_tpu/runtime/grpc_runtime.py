@@ -76,15 +76,26 @@ class GrpcRuntime(Runtime):
         flat = ctx.gadget_params.copy_to_map(prefix="gadget.")
         flat.update(ctx.operator_params.copy_to_map())
 
+        cols = ctx.columns
+        is_interval = ctx.desc.gadget_type == GadgetType.TRACE_INTERVALS
+        is_one_shot = ctx.desc.gadget_type == GadgetType.ONE_SHOT
+
         outputs = ["json"]
         if on_batch is not None:
             outputs.append("batch")
         if on_summary is not None:
             outputs.append("summary")
+        if ctx.extra.get("output") == "json":
+            outputs.append("result-json")  # server-side result rendering
+        if is_one_shot and on_event_array is not None:
+            # ask the agent to stream one-shot rows for client-side combining;
+            # without this the agent renders result bytes per node as before
+            outputs.append("combiner")
 
-        cols = ctx.columns
-        is_interval = ctx.desc.gadget_type == GadgetType.TRACE_INTERVALS
         combiner = SnapshotCombiner(ttl_ticks=2) if is_interval else None
+        # one-shot: accumulate every node's rows, flush once when all nodes
+        # are done (ref: parser.EnableCombiner + Flush, grpc-runtime.go:204-207)
+        one_shot_rows: list = []
 
         results = CombinedGadgetResult()
         results_mu = threading.Lock()
@@ -106,6 +117,9 @@ class GrpcRuntime(Runtime):
                 evs.append(ev)
             if combiner is not None:
                 combiner.add_snapshot(node, evs)
+            elif is_one_shot:
+                with results_mu:
+                    one_shot_rows.extend(evs)
             elif on_event_array is not None:
                 on_event_array(evs)
 
@@ -149,10 +163,23 @@ class GrpcRuntime(Runtime):
 
             threading.Thread(target=tick_loop, daemon=True).start()
 
+        # all node streams finishing on their own (one-shot / run-with-result
+        # gadgets) also ends the run — don't wait for a timeout that never fires
+        def all_done_watch():
+            for t in threads:
+                t.join()
+            ctx.cancel()
+
+        threading.Thread(target=all_done_watch, daemon=True).start()
+
         # wait: context timeout/cancel then stop-fanout (ref: :336-353)
         ctx.wait_for_timeout_or_done()
         stop_event.set()
         for t in threads:
             t.join(timeout=STOP_RESULT_TIMEOUT)
         ticker_stop.set()
+        if is_one_shot and on_event_array is not None:
+            # flush even when empty so callers still see `[]` / a header,
+            # matching the local path
+            on_event_array(one_shot_rows)
         return results

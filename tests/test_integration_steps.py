@@ -1,0 +1,163 @@
+"""Cluster-integration-tier tests via the step framework.
+
+Model: integration/inspektor-gadget/trace_exec_test.go:26-90 and siblings —
+each test is a list of steps (gadget command, workload, cleanup) run with
+RunTestSteps, asserting on normalized JSON events. Here the CLI is the
+built binary and synthetic sources are the workload generators.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from inspektor_gadget_tpu.testing import (
+    Command,
+    FuncStep,
+    build_common_data,
+    expect_all_entries_to_match,
+    expect_entries_in_array_to_match,
+    expect_entries_to_match,
+    run_test_steps,
+)
+from inspektor_gadget_tpu.testing.steps import StepError, ig_cli
+
+
+def normalize_trace(e: dict) -> None:
+    """Zero unpredictable fields (ref: trace_exec_test.go normalize fn)."""
+    for k in ("timestamp", "pid", "ppid", "uid", "mountnsid", "tid"):
+        e.pop(k, None)
+
+
+def test_trace_exec_steps():
+    def check(output: str) -> None:
+        expect_entries_to_match(
+            output, normalize_trace,
+            {"comm": "proc-0", "type": "normal", **build_common_data()},
+        )
+
+    steps = [
+        Command(
+            name="trace-exec",
+            cmd=ig_cli("trace", "exec", "--source", "pysynthetic",
+                       "--rate", "5000", "-o", "json"),
+            start_and_stop=True,
+            expected_output_fn=check,
+        ),
+    ]
+    run_test_steps(steps, step_wait=2.0)
+
+
+def test_trace_exec_filter_all_match():
+    cmd = Command(
+        name="trace-exec-filtered",
+        cmd=ig_cli("trace", "exec", "--source", "pysynthetic",
+                   "--rate", "5000", "-F", "comm:proc-1", "-o", "json"),
+        start_and_stop=True,
+        expected_output_fn=lambda out: expect_all_entries_to_match(
+            out, normalize_trace, {"comm": "proc-1"}),
+    )
+    run_test_steps([cmd], step_wait=2.0)
+
+
+def test_snapshot_process_steps():
+    me = os.path.basename(sys.executable)[:16]
+
+    def check(output: str) -> None:
+        entries = [e for e in json.loads(output)
+                   if e["pid"] == os.getpid() or "py" in e["comm"]]
+        assert entries, "test process not in snapshot"
+
+    run_test_steps([
+        Command(name="snapshot-process",
+                cmd=ig_cli("snapshot", "process", "-o", "json"),
+                expected_output_fn=check),
+    ])
+
+
+def test_snapshot_socket_array_match():
+    # open a listening socket as the workload, then snapshot
+    import socket as socklib
+
+    srv = socklib.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def normalize(e: dict) -> None:
+        e.pop("netnsid", None)
+
+    try:
+        run_test_steps([
+            Command(
+                name="snapshot-socket",
+                cmd=ig_cli("snapshot", "socket", "--proto", "tcp",
+                           "-o", "json"),
+                expected_output_fn=lambda out: expect_entries_in_array_to_match(
+                    out, normalize,
+                    {"protocol": "tcp", "status": "LISTEN",
+                     "localport": port}),
+            ),
+        ])
+    finally:
+        srv.close()
+
+
+def test_cleanup_runs_after_failure():
+    ran = {"cleanup": False}
+    steps = [
+        FuncStep(name="boom", fn=lambda: (_ for _ in ()).throw(
+            StepError("induced failure"))),
+        FuncStep(name="never-runs", fn=lambda: pytest.fail(
+            "step after failure must not run")),
+        FuncStep(name="cleanup", fn=lambda: ran.__setitem__("cleanup", True),
+                 cleanup=True),
+    ]
+    with pytest.raises(StepError, match="induced"):
+        run_test_steps(steps)
+    assert ran["cleanup"], "cleanup step must run even after a failure"
+
+
+def test_start_and_stop_kill_on_failure():
+    # a started step is killed (not left running) when a later step fails
+    cmd = Command(
+        name="stream",
+        cmd=ig_cli("trace", "exec", "--source", "pysynthetic",
+                   "--rate", "100", "-o", "json"),
+        start_and_stop=True,
+    )
+    with pytest.raises(StepError, match="later"):
+        run_test_steps([
+            cmd,
+            FuncStep(name="fail", fn=lambda: (_ for _ in ()).throw(
+                StepError("later step failed"))),
+        ])
+    assert not cmd.running
+    assert cmd._proc.poll() is not None, "subprocess must be reaped"
+
+
+def test_expected_regexp_and_string():
+    run_test_steps([
+        Command(name="version", cmd=ig_cli("version"),
+                expected_regexp=r"^ig-tpu \d"),
+    ])
+    with pytest.raises(StepError, match="regexp"):
+        run_test_steps([
+            Command(name="version-bad", cmd=ig_cli("version"),
+                    expected_regexp=r"^not-the-version"),
+        ])
+
+
+def test_profile_cpu_json_output():
+    r = subprocess.run(ig_cli("profile", "cpu", "--timeout", "1",
+                              "-o", "json"),
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    rows = json.loads(r.stdout)
+    assert isinstance(rows, list)
+    if rows:
+        assert "comm" in rows[0] and "samples" in rows[0]
