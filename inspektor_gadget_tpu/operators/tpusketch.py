@@ -83,8 +83,12 @@ class TpuSketch(Operator):
             ParamDesc(key="anomaly", default="false", type_hint=TypeHint.BOOL,
                       description="train the autoencoder anomaly scorer"),
             ParamDesc(key="anomaly-model", default="ae",
-                      possible_values=("ae", "vae"),
-                      description="anomaly scorer family"),
+                      possible_values=("ae", "vae", "seq"),
+                      description="anomaly scorer family (distribution AE, "
+                                  "distribution VAE, or sequence LM)"),
+            ParamDesc(key="seq-window", default="256", type_hint=TypeHint.INT,
+                      description="per-container token window for the "
+                                  "sequence scorer"),
             ParamDesc(key="harvest-interval", default="1s",
                       type_hint=TypeHint.DURATION),
         ])
@@ -120,6 +124,9 @@ class TpuSketchInstance(OperatorInstance):
                               if "anomaly-model" in p else "ae")
         self.scorer = None
         self._container_counts: dict[int, np.ndarray] = {}
+        self._container_seqs: dict[int, list[int]] = {}
+        self._seq_window = (p.get("seq-window").as_int()
+                            if "seq-window" in p else 256)
         if self.anomaly_on:
             dim = 1 << p.get("entropy-log2-width").as_int()
             if self.anomaly_model == "vae":
@@ -127,6 +134,10 @@ class TpuSketchInstance(OperatorInstance):
                 self._ae_cfg = VAEConfig(input_dim=dim, hidden_dim=256,
                                          latent_dim=64)
                 self.scorer = vae_init(self._ae_cfg)
+            elif self.anomaly_model == "seq":
+                from ..models.seqmodel import SeqConfig, seq_init
+                self._ae_cfg = SeqConfig(vocab=min(dim, 512))
+                self.scorer = seq_init(self._ae_cfg)
             else:
                 self._ae_cfg = AEConfig(input_dim=dim, hidden_dim=256,
                                         latent_dim=64)
@@ -198,15 +209,48 @@ class TpuSketchInstance(OperatorInstance):
             self.harvest()
 
     def _accumulate_container_dists(self, batch: EventBatch, n: int) -> None:
-        dim = self._ae_cfg.input_dim
         mntns = batch.cols["mntns"][:n]
         keys = batch.cols[self.dist_col][:n]
+        if self.anomaly_model == "seq":
+            # per-container token *sequences* (order matters) for the LM
+            from ..models.seqmodel import tokens_from_keys
+            toks = tokens_from_keys(keys, self._ae_cfg.vocab)
+            w = self._seq_window
+            for ns in np.unique(mntns):
+                seq = self._container_seqs.setdefault(int(ns), [])
+                seq.extend(int(t) for t in toks[mntns == ns])
+                if len(seq) > w:
+                    del seq[:-w]
+            return
+        dim = self._ae_cfg.input_dim
         buckets = (keys % np.uint64(dim)).astype(np.int64)
         for ns in np.unique(mntns):
             sel = mntns == ns
             vec = self._container_counts.setdefault(
                 int(ns), np.zeros(dim, dtype=np.float32))
             np.add.at(vec, buckets[sel], 1.0)
+
+    def _seq_score_containers(self) -> dict[int, float] | None:
+        """Train the sequence LM one step on all container windows and
+        return per-container mean next-token NLL."""
+        from ..models.seqmodel import seq_score, seq_train_step
+        ready = {ns: s for ns, s in self._container_seqs.items() if len(s) >= 4}
+        if not ready:
+            return None
+        # pad width to a power of two: bounds the set of compiled shapes
+        w = max(len(s) for s in ready.values())
+        w = min(1 << (w - 1).bit_length(), self._seq_window)
+        rows = 1 << (len(ready) - 1).bit_length() if len(ready) > 1 else 1
+        # filler rows stay all -1: fully-masked rows are loss-neutral (the
+        # NLL denominators are clamped to 1) and their scores are dropped
+        # by the zip truncation below
+        mat = np.full((rows, w), -1, dtype=np.int32)
+        for i, s in enumerate(ready.values()):
+            mat[i, :len(s)] = s
+        toks = jnp.asarray(mat)
+        self.scorer, _ = seq_train_step(self.scorer, toks)
+        scores = np.asarray(seq_score(self.scorer, toks))
+        return {ns: float(s) for ns, s in zip(ready.keys(), scores)}
 
     # harvest ---------------------------------------------------------------
 
@@ -217,7 +261,9 @@ class TpuSketchInstance(OperatorInstance):
         order = np.argsort(-counts)
         hh = [(int(keys[i]), int(counts[i])) for i in order if keys[i] != 0]
         anomaly = None
-        if self.anomaly_on and self._container_counts:
+        if self.anomaly_on and self.anomaly_model == "seq":
+            anomaly = self._seq_score_containers()
+        elif self.anomaly_on and self._container_counts:
             mats = np.stack(list(self._container_counts.values()))
             x = normalize_counts(jnp.asarray(mats))
             if self.anomaly_model == "vae":

@@ -200,3 +200,34 @@ def test_native_containers_map_mirror():
     assert containers_map_lookup(777124) == "dbby"
     cc.remove_container("nm2")
     assert containers_map_lookup(777124) == ""
+
+
+def test_trace_exec_seq_anomaly_scorer_end_to_end():
+    """tpusketch with the sequence-LM scorer family: per-container NLL
+    scores appear in harvest summaries."""
+    desc = get("trace", "exec")
+    params = desc.params().to_params()
+    params.set("source", "pysynthetic")
+    params.set("rate", "50000")
+    op_params = Collection()
+    from inspektor_gadget_tpu.operators.operators import get as get_op
+    sketch_params = get_op("tpusketch").instance_params().to_params()
+    sketch_params.set("enable", "true")
+    sketch_params.set("log2-width", "10")
+    sketch_params.set("hll-p", "8")
+    sketch_params.set("anomaly", "true")
+    sketch_params.set("anomaly-model", "seq")
+    sketch_params.set("seq-window", "128")
+    sketch_params.set("harvest-interval", "300ms")
+    op_params["operator.tpusketch."] = sketch_params
+    summaries = []
+    ctx = GadgetContext(
+        desc, gadget_params=params, operator_params=op_params, timeout=1.2,
+        extra={"on_sketch_summary": summaries.append},
+    )
+    result = LocalRuntime().run_gadget(ctx)
+    assert not result.errors()
+    scored = [s for s in summaries if s.anomaly]
+    assert scored, "sequence scorer must emit per-container scores"
+    for ns, score in scored[-1].anomaly.items():
+        assert score == score and score >= 0  # finite NLL
