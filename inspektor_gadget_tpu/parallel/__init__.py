@@ -18,10 +18,21 @@ from .cluster import (
     ClusterState,
     cluster_init,
 )
+from .moe import make_ep_moe, moe_apply, moe_init, moe_pspecs
+from .pipeline import (
+    make_pp_forward,
+    make_pp_train_step,
+    pp_block_init,
+    pp_pspecs,
+    pp_reference,
+)
 
 __all__ = [
     "make_mesh", "node_axis", "MeshSpec",
     "cluster_sketch_step", "cluster_merge", "make_cluster_step",
     "ClusterState", "cluster_init",
     "ring_psum", "ring_psum_chunked",
+    "make_ep_moe", "moe_apply", "moe_init", "moe_pspecs",
+    "make_pp_forward", "make_pp_train_step", "pp_block_init", "pp_pspecs",
+    "pp_reference",
 ]

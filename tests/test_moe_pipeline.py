@@ -1,0 +1,101 @@
+"""Expert-parallel MoE and pipeline-parallel tests (8-device CPU mesh).
+
+Correctness bar for both: the distributed execution must equal the
+single-device reference bit-for-bit-ish (fp32 tolerances) — the same
+"sharded == sequential" contract the cluster sketch merge tests enforce.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from inspektor_gadget_tpu.parallel import (
+    make_ep_moe,
+    make_pp_forward,
+    make_pp_train_step,
+    moe_apply,
+    moe_init,
+    pp_block_init,
+    pp_reference,
+)
+
+
+def expert_mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("expert",))
+
+
+def stage_mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("stage",))
+
+
+def test_moe_reference_routes_and_balances():
+    params = moe_init(jax.random.PRNGKey(0), n_experts=8, d_model=32, d_ff=64)
+    x = jax.random.normal(jax.random.PRNGKey(1), (128, 32))
+    y, (bal, drop) = moe_apply(params, x, capacity_factor=2.0)
+    assert y.shape == x.shape
+    assert float(bal) >= 1.0 - 1e-5  # balance loss is minimized at 1
+    assert 0.0 <= float(drop) <= 1.0
+    # ample capacity → nothing dropped, every token touched by an expert
+    y2, (_, drop2) = moe_apply(params, x, capacity_factor=8.0)
+    assert float(drop2) == 0.0
+    assert float(jnp.abs(y2).sum()) > 0
+
+
+def test_ep_moe_matches_reference():
+    mesh = expert_mesh()
+    n_tok = 256
+    params = moe_init(jax.random.PRNGKey(0), n_experts=8, d_model=32, d_ff=64)
+    x = jax.random.normal(jax.random.PRNGKey(1), (n_tok, 32))
+    ep = make_ep_moe(mesh, n_experts=8, capacity_factor=8.0)
+    y_ep, (bal_ep, drop_ep) = ep(params, x)
+    # reference computed per token shard (capacity is per-shard in EP), then
+    # concatenated: run moe_apply on each 32-token shard independently.
+    shards = [
+        moe_apply(params, x[i * 32:(i + 1) * 32], capacity_factor=8.0)
+        for i in range(8)
+    ]
+    y_ref = jnp.concatenate([s[0] for s in shards])
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                               rtol=2e-2, atol=2e-2)
+    assert float(drop_ep) == 0.0
+
+
+def test_ep_moe_capacity_drops_are_reported():
+    mesh = expert_mesh()
+    params = moe_init(jax.random.PRNGKey(2), n_experts=8, d_model=16, d_ff=32)
+    # adversarial input: identical tokens all route to one expert
+    x = jnp.tile(jax.random.normal(jax.random.PRNGKey(3), (1, 16)), (256, 1))
+    ep = make_ep_moe(mesh, n_experts=8, capacity_factor=1.0)
+    _, (_, drop) = ep(params, x)
+    # capacity 32/8*1 = 4 per expert per shard; 32 tokens/shard to one expert
+    assert float(drop) > 0.8
+
+
+def test_pp_forward_matches_sequential():
+    mesh = stage_mesh()
+    params = pp_block_init(jax.random.PRNGKey(0), n_stages=8, d_model=32,
+                           d_ff=64)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 32))  # [M, mb, d]
+    y_pp = make_pp_forward(mesh)(params, x)
+    y_ref = jnp.stack([pp_reference(params, x[i]) for i in range(4)])
+    np.testing.assert_allclose(np.asarray(y_pp), np.asarray(y_ref),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_pp_train_step_learns():
+    mesh = stage_mesh()
+    params = pp_block_init(jax.random.PRNGKey(0), n_stages=8, d_model=16,
+                           d_ff=32)
+    head = jax.random.normal(jax.random.PRNGKey(1), (16, 4)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 8, 16))
+    y = jax.random.normal(jax.random.PRNGKey(3), (4, 8, 4))
+    step = make_pp_train_step(mesh, lr=1e-2)
+    losses = []
+    p, h = params, head
+    for _ in range(20):
+        p, h, loss = step(p, h, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7
+    # block grads stayed stage-sharded: param tree shape unchanged
+    assert p["w1"].shape == params["w1"].shape
