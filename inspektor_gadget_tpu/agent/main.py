@@ -27,6 +27,11 @@ def main(argv=None) -> int:
     sp = sub.add_parser("serve")
     sp.add_argument("--listen", default="unix:///tmp/igtpu-agent.sock")
     sp.add_argument("--node-name", default="node")
+    sp.add_argument("--pod-manifest", default="",
+                    help="JSON pod manifest to watch with the pod informer")
+    sp.add_argument("--kube-api", default="",
+                    help="apiserver URL for pod-informer discovery")
+    sp.add_argument("--informer-interval", type=float, default=2.0)
 
     for name in ("liveness", "dump"):
         p = sub.add_parser(name)
@@ -48,6 +53,19 @@ def main(argv=None) -> int:
     if args.cmd == "serve":
         from .service import serve
         server, _agent = serve(args.listen, node_name=args.node_name)
+        if args.pod_manifest or args.kube_api:
+            # pod-informer discovery feeding the localmanager collection
+            # (ref: WithPodInformer wired in main.go's serve path)
+            from ..containers import (
+                file_pod_source, kube_api_pod_source, with_pod_informer,
+            )
+            from ..operators.operators import ensure_initialized
+            lm = ensure_initialized("localmanager")
+            src = (file_pod_source(args.pod_manifest) if args.pod_manifest
+                   else kube_api_pod_source(args.kube_api,
+                                            node_name=args.node_name))
+            with_pod_informer(src, node_name=args.node_name,
+                              interval=args.informer_interval)(lm.cc)
         print(f"ig-tpu-agent listening on {args.listen}", flush=True)
         stop = [False]
 

@@ -202,10 +202,8 @@ class AgentServer:
 
     def add_container(self, request: bytes, context) -> bytes:
         h, _ = wire.decode_msg(request)
-        from ..operators.operators import get as get_op
-        lm = get_op("localmanager")
-        if lm.cc is None:
-            lm.init(lm.global_params().to_params())
+        from ..operators.operators import ensure_initialized
+        lm = ensure_initialized("localmanager")
         c = h.get("container", {})
         lm.cc.add_container(Container(
             id=c.get("id", ""), name=c.get("name", ""),
@@ -237,7 +235,27 @@ class AgentServer:
             frames[str(tid)] = stack
         with self._runs_mu:
             runs = list(self._runs)
-        return wire.encode_msg({"threads": frames, "active_runs": runs})
+        # container set, as the reference's DumpState does
+        # (gadgettracermanager.go:204-219 dumps containers + stacks)
+        containers: list = []
+        dump_error = ""
+        try:
+            from ..operators.operators import get as get_op
+            lm = get_op("localmanager")
+            if lm.cc is not None:
+                containers = [
+                    {"id": c.id, "name": c.name, "pid": c.pid,
+                     "mntns": c.mntns, "namespace": c.namespace, "pod": c.pod,
+                     "runtime": c.runtime}
+                    for c in lm.cc.get_all()
+                ]
+        except Exception as e:
+            dump_error = f"container dump failed: {e!r}"
+        msg = {"threads": frames, "active_runs": runs,
+               "containers": containers}
+        if dump_error:
+            msg["error"] = dump_error
+        return wire.encode_msg(msg)
 
 
 def _method(behavior, kind):

@@ -12,11 +12,14 @@ from .collection import ContainerCollection, EventType, PubSubEvent
 from .tracer_collection import TracerCollection
 from .options import (
     with_fake_containers,
+    with_fallback_pod_informer,
+    with_pod_informer,
     with_procfs_discovery,
     with_node_name,
     with_cgroup_enrichment,
     with_linux_namespace_enrichment,
 )
+from .podinformer import PodInformer, file_pod_source, kube_api_pod_source
 
 __all__ = [
     "Container", "ContainerSelector",
@@ -24,4 +27,6 @@ __all__ = [
     "TracerCollection",
     "with_fake_containers", "with_procfs_discovery", "with_node_name",
     "with_cgroup_enrichment", "with_linux_namespace_enrichment",
+    "with_pod_informer", "with_fallback_pod_informer",
+    "PodInformer", "file_pod_source", "kube_api_pod_source",
 ]

@@ -208,6 +208,44 @@ def with_native_containers_map():
     return opt
 
 
+def with_pod_informer(source, node_name: str = "", interval: float = 2.0):
+    """Discover containers from this node's pods via a polling informer
+    (ref: options.go:199 WithPodInformer → pkg/container-collection/
+    podinformer.go). `source` is any PodSource: a callable, or build one
+    with podinformer.file_pod_source / kube_api_pod_source. Does one
+    synchronous refresh (the initial-containers snapshot, ref
+    options.go:320) then polls in the background."""
+
+    def opt(cc: ContainerCollection):
+        from .podinformer import PodInformer
+
+        inf = PodInformer(source, node_name=node_name or cc.node_name,
+                          interval=interval)
+        inf.on_add = cc.add_container
+        inf.on_remove = cc.remove_container
+        inf.refresh()
+        inf.start()
+        cc._pod_informer = inf  # keep alive with the collection
+
+    return opt
+
+
+def with_fallback_pod_informer(source, node_name: str = "",
+                               interval: float = 2.0):
+    """Pod informer that only activates when no other discovery backend
+    produced containers (ref: options.go:207 WithFallbackPodInformer —
+    used when the runtime socket is absent). Must be last in the option
+    list, as in the reference."""
+
+    inner = with_pod_informer(source, node_name, interval)
+
+    def opt(cc: ContainerCollection):
+        if len(cc) == 0:
+            inner(cc)
+
+    return opt
+
+
 def with_procfs_discovery(max_pids: int = 4096):
     """Discover initial 'containers' by scanning /proc session leaders with
     distinct mount namespaces — the no-runtime-socket analogue of
