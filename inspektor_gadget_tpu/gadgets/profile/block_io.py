@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import time
 
-from ...params import ParamDescs
+from ...params import ParamDesc, ParamDescs, TypeHint
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
 from ..top.block_io import _read_diskstats
@@ -40,9 +40,17 @@ def render_log2_hist(buckets: list[int], unit: str = "usecs") -> bytes:
 class ProfileBlockIo:
     def __init__(self, ctx):
         self.ctx = ctx
+        p = ctx.gadget_params
+        self.quantiles = (p.get("quantiles").as_bool()
+                          if p and "quantiles" in p else False)
 
     def run_with_result(self, ctx) -> bytes:
         buckets = [0] * 32
+        # pending (latency_s, weight) since the last sketch fold; flushed
+        # every _FLUSH ticks so memory stays O(n_buckets), not O(runtime) —
+        # DDSketch is an online structure, feed it online
+        pending: list[tuple[float, int]] = []
+        sketch = None
         prev = _read_diskstats()
         while not ctx.done:
             if ctx.sleep_or_done(0.05):
@@ -57,8 +65,43 @@ class ProfileBlockIo:
                 if dios > 0 and dq_ms >= 0:
                     avg_us = max(int(dq_ms * 1000 / dios), 1)
                     buckets[min(avg_us.bit_length(), 31)] += dios
+                    if self.quantiles:
+                        pending.append((avg_us / 1e6, dios))
             prev = cur
-        return render_log2_hist(buckets)
+            if len(pending) >= self._FLUSH:
+                sketch = self._fold(sketch, pending)
+                pending = []
+        if pending:
+            sketch = self._fold(sketch, pending)
+        out = render_log2_hist(buckets)
+        if sketch is not None:
+            out += self._quantile_summary(sketch)
+        return out
+
+    _FLUSH = 256
+
+    def _fold(self, sketch, pending):
+        """Fold pending observations into the mergeable DDSketch — the
+        cluster-aggregatable plane the reference's per-node histogram lacks
+        (sketch state psum-merges across nodes via ops.dd_psum)."""
+        import jax.numpy as jnp
+
+        from ...ops import dd_init, dd_update
+
+        vals = jnp.asarray([v for v, _ in pending], jnp.float32)
+        w = jnp.asarray([w for _, w in pending], jnp.float32)
+        return dd_update(sketch if sketch is not None else dd_init(alpha=0.01),
+                         vals, w)
+
+    def _quantile_summary(self, sketch) -> bytes:
+        import jax.numpy as jnp
+
+        from ...ops import dd_quantile
+
+        qs = dd_quantile(sketch, jnp.asarray([0.5, 0.95, 0.99]))
+        p50, p95, p99 = (float(x) * 1e6 for x in qs)
+        return (f"\nlatency quantiles (usecs, ddsketch alpha=1%): "
+                f"p50={p50:.0f} p95={p95:.0f} p99={p99:.0f}\n").encode()
 
     run = run_with_result
 
@@ -72,7 +115,11 @@ class ProfileBlockIoDesc(GadgetDesc):
     event_cls = None
 
     def params(self) -> ParamDescs:
-        return ParamDescs()
+        return ParamDescs([
+            ParamDesc(key="quantiles", default="false",
+                      type_hint=TypeHint.BOOL,
+                      description="append mergeable DDSketch p50/p95/p99"),
+        ])
 
     def new_instance(self, ctx) -> ProfileBlockIo:
         return ProfileBlockIo(ctx)

@@ -46,6 +46,12 @@ class SeqConfig:
     d_ff: int = 256
     lr: float = 1e-3
     dtype: Any = jnp.bfloat16
+    # MoE FF (0 = dense): scorer capacity scales by adding experts without
+    # growing per-token FLOPs; experts shard over an 'expert' mesh axis via
+    # make_ep_train_step (parallel/moe.py all_to_all dispatch)
+    n_experts: int = 0
+    capacity_factor: float = 2.0
+    balance_coef: float = 0.01
 
 
 @dataclasses.dataclass
@@ -74,14 +80,19 @@ def seq_init(cfg: SeqConfig = SeqConfig(), seed: int = 0) -> SeqScorer:
     d, f = cfg.d_model, cfg.d_ff
     layers = []
     for _ in range(cfg.n_layers):
-        layers.append({
+        layer = {
             "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
             "qkv": dense(d, 3 * d),
             "out": dense(d, d),
             "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
-            "ff1": dense(d, f),
-            "ff2": dense(f, d),
-        })
+        }
+        if cfg.n_experts:
+            from ..parallel.moe import moe_init
+            layer["moe"] = moe_init(next(keys), cfg.n_experts, d, f)
+        else:
+            layer["ff1"] = dense(d, f)
+            layer["ff2"] = dense(f, d)
+        layers.append(layer)
     params = {
         "embed": jax.random.normal(next(keys), (cfg.vocab, d)) * 0.02,
         "layers": layers,
@@ -125,6 +136,38 @@ def _attend(q, k, v, cfg, attn: str, axis_name: str | None):
     raise ValueError(f"unknown attention impl {attn!r}")
 
 
+def _seq_apply_aux(params: dict, tokens: jnp.ndarray, cfg: SeqConfig,
+                   attn: str = "full", axis_name: str | None = None,
+                   pos_offset: jnp.ndarray | int = 0,
+                   ep_axis: str | None = None,
+                   ep_size: int = 1) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(logits [B,T,vocab], moe balance loss) — internal; ep_axis routes MoE
+    layers through the expert-parallel all_to_all path inside shard_map."""
+    b, t = tokens.shape
+    d, h = cfg.d_model, cfg.n_heads
+    pos = pos_offset + jnp.arange(t)
+    x = (params["embed"][tokens] + _sincos_positions(pos, d)).astype(cfg.dtype)
+    balance = jnp.float32(0.0)
+    for lp in params["layers"]:
+        y = _ln(x, lp["ln1"])
+        qkv = _dense(y, lp["qkv"]).reshape(b, t, 3, h, d // h)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a = _attend(q, k, v, cfg, attn, axis_name).reshape(b, t, d)
+        x = x + _dense(a, lp["out"])
+        y = _ln(x, lp["ln2"])
+        if "moe" in lp:
+            from ..parallel.moe import moe_ff
+            ff, (bal, _) = moe_ff(lp["moe"], y.reshape(b * t, d),
+                                  cfg.capacity_factor, axis_name=ep_axis,
+                                  axis_size=ep_size)
+            x = x + ff.reshape(b, t, d)
+            balance = balance + bal
+        else:
+            x = x + _dense(jax.nn.gelu(_dense(y, lp["ff1"])), lp["ff2"])
+    x = _ln(x, params["lnf"])
+    return _dense(x, params["unembed"]).astype(jnp.float32), balance
+
+
 def seq_apply(params: dict, tokens: jnp.ndarray, cfg: SeqConfig,
               attn: str = "full", axis_name: str | None = None,
               pos_offset: jnp.ndarray | int = 0) -> jnp.ndarray:
@@ -133,20 +176,7 @@ def seq_apply(params: dict, tokens: jnp.ndarray, cfg: SeqConfig,
     Under sequence parallelism, `tokens` is the local shard and
     `pos_offset` the global index of its first column.
     """
-    b, t = tokens.shape
-    d, h = cfg.d_model, cfg.n_heads
-    pos = pos_offset + jnp.arange(t)
-    x = (params["embed"][tokens] + _sincos_positions(pos, d)).astype(cfg.dtype)
-    for lp in params["layers"]:
-        y = _ln(x, lp["ln1"])
-        qkv = _dense(y, lp["qkv"]).reshape(b, t, 3, h, d // h)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        a = _attend(q, k, v, cfg, attn, axis_name).reshape(b, t, d)
-        x = x + _dense(a, lp["out"])
-        y = _ln(x, lp["ln2"])
-        x = x + _dense(jax.nn.gelu(_dense(y, lp["ff1"])), lp["ff2"])
-    x = _ln(x, params["lnf"])
-    return _dense(x, params["unembed"]).astype(jnp.float32)
+    return _seq_apply_aux(params, tokens, cfg, attn, axis_name, pos_offset)[0]
 
 
 def _token_nll(logits: jnp.ndarray, targets: jnp.ndarray,
@@ -160,10 +190,10 @@ def _token_nll(logits: jnp.ndarray, targets: jnp.ndarray,
 
 def seq_loss(params: dict, tokens: jnp.ndarray, cfg: SeqConfig,
              attn: str = "full") -> jnp.ndarray:
-    logits = seq_apply(params, tokens[:, :-1], cfg, attn=attn)
+    logits, bal = _seq_apply_aux(params, tokens[:, :-1], cfg, attn=attn)
     mask = (tokens[:, 1:] >= 0).astype(jnp.float32)
     s, c = _token_nll(logits, jnp.maximum(tokens[:, 1:], 0), mask)
-    return s.sum() / jnp.maximum(c.sum(), 1.0)
+    return s.sum() / jnp.maximum(c.sum(), 1.0) + cfg.balance_coef * bal
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "attn"), donate_argnums=(0, 1))
@@ -237,6 +267,69 @@ def make_sp_train_step(mesh: Mesh, cfg: SeqConfig, attn: str = "ring",
         # loss_fn is already the *global* loss (psum'd numerator/denominator),
         # so each rank's grad holds only its local terms: sum, don't average.
         grads = jax.tree.map(lambda g: lax.psum(g, axis), grads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+# --- expert-parallel training (MoE layers sharded over an 'expert' axis) ---
+
+def seq_param_pspecs(params: dict, ep_axis: str):
+    """PartitionSpecs for a MoE seq model: expert FFN stacks sharded on
+    their leading expert dim, everything else (embed, attention, gate,
+    norms) replicated — the standard DP+EP-on-one-axis layout."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _leaf: P(ep_axis) if _is_expert_path(path) else P(),
+        params)
+
+
+def _is_expert_path(path) -> bool:
+    keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+    return "moe" in keys and keys[-1] != "gate"
+
+
+def make_ep_train_step(mesh: Mesh, cfg: SeqConfig, scorer: SeqScorer,
+                       attn: str = "full", axis: str = "expert"):
+    """Build a jitted expert-parallel train step for a MoE seq scorer:
+    token batches [B, T] sharded over `axis` (data parallel), MoE expert
+    stacks sharded over the same axis (expert parallel — the layers take
+    the all_to_all dispatch path), dense params replicated with psum'd
+    grads. Expert grads need no reduction: the all_to_all backprop already
+    delivers every rank's contribution to the owning shard. `scorer` is
+    only used as the tree template for partition specs."""
+    if not cfg.n_experts:
+        raise ValueError("make_ep_train_step requires cfg.n_experts > 0")
+    n = mesh.shape[axis]
+    if cfg.n_experts % n:
+        raise ValueError(f"n_experts={cfg.n_experts} not divisible by {n}")
+    opt = _optimizer(cfg)
+
+    pspecs = seq_param_pspecs(scorer.params, axis)
+    # optimizer state embeds copies of the param tree per moment; the same
+    # path rule shards expert moments and replicates the rest + scalars
+    ospecs = seq_param_pspecs(scorer.opt_state, axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(pspecs, ospecs, P(axis)),
+        out_specs=(pspecs, ospecs, P()))
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits, bal = _seq_apply_aux(
+                p, tokens[:, :-1], cfg, attn=attn, ep_axis=axis, ep_size=n)
+            mask = (tokens[:, 1:] >= 0).astype(jnp.float32)
+            s, c = _token_nll(logits, jnp.maximum(tokens[:, 1:], 0), mask)
+            nll = (lax.psum(s.sum(), axis)
+                   / jnp.maximum(lax.psum(c.sum(), axis), 1.0))
+            return nll + cfg.balance_coef * lax.pmean(bal, axis)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # replicated leaves: sum local grad terms across ranks; expert
+        # shards: already complete on their owner (see docstring)
+        grads = jax.tree_util.tree_map_with_path(
+            lambda path, g: g if _is_expert_path(path) else lax.psum(g, axis),
+            grads)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 

@@ -13,6 +13,7 @@ columnar hot path; the per-event enrich() remains for the formatter path.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Iterable
 
 from ..gadgets.context import GadgetContext
@@ -95,6 +96,7 @@ class Operators(list):
 
 _REGISTRY: dict[str, Operator] = {}
 _initialized: set[str] = set()
+_init_lock = threading.Lock()
 
 
 def register(op: Operator) -> Operator:
@@ -121,11 +123,13 @@ def ensure_initialized(name: str) -> Operator:
     the same _initialized set install_operators consults, so a later gadget
     run won't re-init and replace its state (e.g. localmanager's container
     collection — anything attached to it, like a pod informer, would be
-    orphaned by a second init)."""
+    orphaned by a second init). Thread-safe: gRPC handler threads and the
+    daemon main thread may race here."""
     op = get(name)
-    if name not in _initialized:
-        op.init(op.global_params().to_params())
-        _initialized.add(name)
+    with _init_lock:
+        if name not in _initialized:
+            op.init(op.global_params().to_params())
+            _initialized.add(name)
     return op
 
 
@@ -206,9 +210,10 @@ def install_operators(
     ops = operators if operators is not None else get_operators_for_gadget(ctx.desc)
     instances = Operators()
     for op in ops:
-        if op.name not in _initialized:
-            op.init(op.global_params().to_params())
-            _initialized.add(op.name)
+        with _init_lock:
+            if op.name not in _initialized:
+                op.init(op.global_params().to_params())
+                _initialized.add(op.name)
         prefix = f"operator.{op.name}."
         iparams = None
         if params_by_operator is not None and prefix in params_by_operator:

@@ -1,0 +1,132 @@
+"""DDSketch-style quantile sketch (latency-distribution plane).
+
+Role in the framework: generalizes the reference's in-kernel log2 latency
+histograms (`profile block-io`, biolatency.bpf.c log2 buckets; fsslower's
+min-latency threshold) into a mergeable relative-error quantile summary.
+Where the reference renders a per-node ASCII histogram and cannot combine
+nodes, this sketch answers p50/p95/p99 with guaranteed relative accuracy
+and merges across the cluster with one psum — the quantile analogue of the
+count-min plane.
+
+Math (DDSketch, Masson et al. 2019, public algorithm): values map to
+log-spaced buckets i = ceil(log_gamma(v)) with gamma = (1+alpha)/(1-alpha);
+any quantile read back from bucket midpoints has relative error ≤ alpha.
+Merge = bucket-wise add, exactly like the log2 histogram the reference
+drains from its BPF map — but with tunable accuracy and a zero/underflow
+bucket.
+
+TPU-first: the state is one (n_buckets,) float32 row; a batch update is a
+one-hot matmul histogram (MXU path, same trick as ops/pallas_kernels.py)
+or scatter-add — both static-shape, jit/psum friendly.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class DDSketch:
+    counts: jnp.ndarray   # (n_buckets,) float32 — log-gamma spaced
+    zeros: jnp.ndarray    # () float32 — values below min_value
+    total: jnp.ndarray    # () float32
+    alpha: float = flax.struct.field(pytree_node=False)
+    min_value: float = flax.struct.field(pytree_node=False)
+
+    @property
+    def gamma(self) -> float:
+        return (1.0 + self.alpha) / (1.0 - self.alpha)
+
+
+def dd_init(alpha: float = 0.01, n_buckets: int = 2048,
+            min_value: float = 1e-9) -> DDSketch:
+    """alpha = target relative error (1% default); 2048 buckets at 1%
+    span ~1e-9..1e9 — nanoseconds to ~30s of latency in one row."""
+    return DDSketch(
+        counts=jnp.zeros((n_buckets,), jnp.float32),
+        zeros=jnp.zeros((), jnp.float32),
+        total=jnp.zeros((), jnp.float32),
+        alpha=alpha,
+        min_value=min_value,
+    )
+
+
+def _bucket_index(state: DDSketch, values: jnp.ndarray) -> jnp.ndarray:
+    inv_log_gamma = 1.0 / math.log(state.gamma)
+    offset = math.log(state.min_value) * inv_log_gamma
+    v = jnp.maximum(values.astype(jnp.float32), state.min_value)
+    idx = jnp.ceil(jnp.log(v) * inv_log_gamma - offset)
+    return jnp.clip(idx, 0, state.counts.shape[0] - 1).astype(jnp.int32)
+
+
+def dd_update(state: DDSketch, values: jnp.ndarray,
+              mask: jnp.ndarray | None = None) -> DDSketch:
+    """Fold a batch of non-negative values (e.g. latencies in seconds).
+    Masked/padded slots pass weight 0; exact zeros land in the zero
+    bucket, as in the reference DDSketch."""
+    w = jnp.ones(values.shape, jnp.float32) if mask is None else mask.astype(jnp.float32)
+    is_zero = (values <= 0).astype(jnp.float32) * w
+    w_pos = w - is_zero
+    idx = _bucket_index(state, values)
+    counts = state.counts.at[idx].add(w_pos)
+    return state.replace(
+        counts=counts,
+        zeros=state.zeros + is_zero.sum(),
+        total=state.total + w.sum(),
+    )
+
+
+def dd_quantile(state: DDSketch, q) -> jnp.ndarray:
+    """Value at quantile q (scalar or array of quantiles in [0,1]); bucket
+    midpoint 2·gamma^i/(gamma+1) ⇒ relative error ≤ alpha. Returns 0.0 for
+    ranks inside the zero bucket; NaN when the sketch is empty."""
+    qs = jnp.atleast_1d(jnp.asarray(q, jnp.float32))
+    rank = qs * jnp.maximum(state.total - 1.0, 0.0)
+    cum = state.zeros + jnp.cumsum(state.counts)
+    # first bucket whose cumulative count exceeds the rank
+    bucket = (cum[None, :] <= rank[:, None]).sum(axis=1)
+    bucket = jnp.clip(bucket, 0, state.counts.shape[0] - 1)
+    log_gamma = math.log(state.gamma)
+    offset = math.log(state.min_value) / log_gamma
+    # DDSketch estimate for bucket b: 2·γ^b/(γ+1), shifted by min_value
+    mid = (2.0 * jnp.exp((bucket.astype(jnp.float32) + offset) * log_gamma)
+           / (state.gamma + 1.0))
+    in_zero = rank < state.zeros
+    out = jnp.where(in_zero, 0.0, mid)
+    out = jnp.where(state.total > 0, out, jnp.nan)
+    return out[0] if jnp.ndim(q) == 0 else out
+
+
+def dd_merge(a: DDSketch, b: DDSketch) -> DDSketch:
+    return a.replace(counts=a.counts + b.counts, zeros=a.zeros + b.zeros,
+                     total=a.total + b.total)
+
+
+def dd_psum(state: DDSketch, axis_name: str) -> DDSketch:
+    """Cluster-wide quantiles: one all-reduce over the mesh axis (the
+    snapshotcombiner role, pkg/snapshotcombiner/snapshotcombiner.go:56-106,
+    for latency distributions)."""
+    return state.replace(
+        counts=jax.lax.psum(state.counts, axis_name),
+        zeros=jax.lax.psum(state.zeros, axis_name),
+        total=jax.lax.psum(state.total, axis_name),
+    )
+
+
+def dd_histogram_log2(state: DDSketch, n_slots: int = 27) -> jnp.ndarray:
+    """Re-bin onto log2 buckets (the reference's biolatency rendering,
+    profile/block-io ASCII histogram) for display parity: slot k counts
+    values in [2^k, 2^(k+1)) microseconds, assuming values in seconds."""
+    n = state.counts.shape[0]
+    log_gamma = math.log(state.gamma)
+    offset = math.log(state.min_value) / log_gamma
+    # midpoint value of every dd bucket, in microseconds
+    mids_us = (jnp.exp((jnp.arange(n, dtype=jnp.float32) + offset) * log_gamma)
+               * 1e6)
+    slot = jnp.clip(jnp.floor(jnp.log2(jnp.maximum(mids_us, 1.0))),
+                    0, n_slots - 1).astype(jnp.int32)
+    return jnp.zeros((n_slots,), jnp.float32).at[slot].add(state.counts)

@@ -96,14 +96,38 @@ def _expert_ffn(w1, b1, w2, b2, h):
 def moe_apply(params: dict, x: jnp.ndarray,
               capacity_factor: float = 2.0) -> tuple[jnp.ndarray, tuple]:
     """Single-device reference MoE: x [T, d] → ([T, d], aux). All experts
-    local; the EP path below must produce identical outputs."""
+    local; the EP path must produce identical outputs (tests enforce it),
+    so both are the same moe_ff code path."""
+    return moe_ff(params, x, capacity_factor)
+
+
+def moe_ff(params: dict, x: jnp.ndarray, capacity_factor: float = 2.0,
+           axis_name: str | None = None,
+           axis_size: int = 1) -> tuple[jnp.ndarray, tuple]:
+    """Routed FF usable as a drop-in for a dense FF block: x [T, d] →
+    (y [T, d], (balance_loss, drop_frac)). With `axis_name` set (inside
+    shard_map over the expert axis), experts are sharded and dispatch takes
+    the two all_to_all hops; otherwise all experts are local. This is the
+    building block models embed (models/seqmodel.py MoE layers);
+    make_ep_moe wraps it as a standalone jitted fn."""
     t = x.shape[0]
-    n_e = params["gate"].shape[1]
-    capacity = max(1, int(t / n_e * capacity_factor))
-    dispatch, combine, aux = _route(x, params["gate"], capacity)
+    n_experts = params["gate"].shape[1]  # gate is replicated, global width
+    if axis_name and params["w1"].shape[0] * axis_size != n_experts:
+        raise ValueError(
+            f"expert shard {params['w1'].shape[0]} × axis {axis_size} != "
+            f"gate width {n_experts}")
+    capacity = max(1, int(t / n_experts * capacity_factor))
+    dispatch, combine, (bal, drop) = _route(x, params["gate"], capacity)
     h = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    if axis_name:
+        h = lax.all_to_all(h, axis_name, split_axis=0, concat_axis=1,
+                           tiled=True)
     out = _expert_ffn(params["w1"], params["b1"], params["w2"], params["b2"], h)
-    return jnp.einsum("tec,ecd->td", combine, out).astype(x.dtype), aux
+    if axis_name:
+        out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                             tiled=True)
+    y = jnp.einsum("tec,ecd->td", combine, out).astype(x.dtype)
+    return y, (bal, drop)
 
 
 def make_ep_moe(mesh: Mesh, n_experts: int, capacity_factor: float = 2.0,
@@ -122,18 +146,10 @@ def make_ep_moe(mesh: Mesh, n_experts: int, capacity_factor: float = 2.0,
         in_specs=(moe_pspecs(axis), P(axis)),
         out_specs=(P(axis), (P(), P())))
     def ep(params, x):
-        t_local = x.shape[0]
-        capacity = max(1, int(t_local / n_experts * capacity_factor))
-        dispatch, combine, (bal, drop) = _route(x, params["gate"], capacity)
-        # local dispatch over ALL experts, then route blocks to their owners:
-        # [T_l, E, C] → [E, C, d] → all_to_all → [E/n, n*C, d]
-        h = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
-        h = lax.all_to_all(h, axis, split_axis=0, concat_axis=1, tiled=True)
-        out = _expert_ffn(params["w1"], params["b1"],
-                          params["w2"], params["b2"], h)
-        # send each [E/n, C, d] block back to the rank owning those tokens
-        out = lax.all_to_all(out, axis, split_axis=1, concat_axis=0, tiled=True)
-        y = jnp.einsum("tec,ecd->td", combine, out).astype(x.dtype)
+        # local dispatch over ALL experts, then two all_to_all hops:
+        # tokens → owning expert shard, expert outputs → token owner
+        y, (bal, drop) = moe_ff(params, x, capacity_factor,
+                                axis_name=axis, axis_size=n)
         return y, (lax.pmean(bal, axis), lax.pmean(drop, axis))
 
     return jax.jit(ep)

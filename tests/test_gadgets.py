@@ -91,6 +91,16 @@ def test_profile_blockio_histogram_renders():
     assert b"usecs" in result and b"distribution" in result
 
 
+def test_profile_blockio_quantiles_param():
+    result, _, _ = run_gadget("profile", "block-io", timeout=0.8,
+                              param_overrides={"quantiles": "true"})
+    # quantile line appears whenever any IO was observed in the window
+    if b"p50=" in result:
+        assert b"ddsketch" in result and b"p99=" in result
+    else:  # idle disk: histogram still renders, no quantile line
+        assert b"distribution" in result
+
+
 def test_profile_cpu_columns_and_folded():
     result, _, _ = run_gadget("profile", "cpu", timeout=0.7)
     assert b"SAMPLES" in result

@@ -72,6 +72,54 @@ def test_ep_moe_capacity_drops_are_reported():
     assert float(drop) > 0.8
 
 
+def test_moe_seq_model_trains_single_chip():
+    """SeqConfig.n_experts swaps the dense FF for routed experts; the LM
+    still learns (loss decreases) and scoring works unchanged."""
+    from inspektor_gadget_tpu.models.seqmodel import (
+        SeqConfig, seq_init, seq_score, seq_train_step,
+    )
+
+    cfg = SeqConfig(vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                    n_experts=4)
+    sc = seq_init(cfg, seed=0)
+    assert "moe" in sc.params["layers"][0] and "ff1" not in sc.params["layers"][0]
+    rng = np.random.default_rng(0)
+    # learnable structure: repeating bigrams
+    toks = jnp.asarray(np.tile(rng.integers(0, 32, (4, 2)), (1, 16)),
+                       jnp.int32)
+    losses = []
+    for _ in range(30):
+        sc, loss = seq_train_step(sc, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7
+    scores = seq_score(sc, toks)
+    assert scores.shape == (4,) and np.isfinite(np.asarray(scores)).all()
+
+
+def test_ep_train_step_matches_structure_and_learns():
+    """Expert-parallel step: experts sharded over the mesh, loss decreases,
+    and params stay numerically consistent with their global shapes."""
+    from inspektor_gadget_tpu.models.seqmodel import (
+        SeqConfig, make_ep_train_step, seq_init,
+    )
+
+    mesh = expert_mesh()
+    cfg = SeqConfig(vocab=32, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+                    n_experts=8)
+    sc = seq_init(cfg, seed=1)
+    step = make_ep_train_step(mesh, cfg, sc)
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(np.tile(rng.integers(0, 32, (8, 2)), (1, 16)),
+                       jnp.int32)
+    p, o = sc.params, sc.opt_state
+    losses = []
+    for _ in range(25):
+        p, o, loss = step(p, o, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8
+    assert p["layers"][0]["moe"]["w1"].shape == (8, 32, 64)
+
+
 def test_pp_forward_matches_sequential():
     mesh = stage_mesh()
     params = pp_block_init(jax.random.PRNGKey(0), n_stages=8, d_model=32,

@@ -1,0 +1,86 @@
+"""DDSketch quantile-plane tests: relative-error guarantee, mergeability
+(sharded == sequential, the cluster-merge contract), log2 re-binning parity
+with the reference's biolatency histogram."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from inspektor_gadget_tpu.ops import (
+    dd_histogram_log2, dd_init, dd_merge, dd_psum, dd_quantile, dd_update,
+)
+
+
+def test_quantile_relative_error_bound():
+    rng = np.random.default_rng(0)
+    vals = rng.lognormal(mean=-6.0, sigma=2.0, size=20000).astype(np.float32)
+    sk = dd_init(alpha=0.01)
+    sk = jax.jit(dd_update)(sk, jnp.asarray(vals))
+    for q in (0.5, 0.9, 0.95, 0.99):
+        est = float(dd_quantile(sk, q))
+        true = float(np.quantile(vals, q))
+        assert abs(est - true) / true < 0.02, (q, est, true)
+
+
+def test_zero_bucket_and_empty():
+    sk = dd_init(alpha=0.02)
+    assert np.isnan(float(dd_quantile(sk, 0.5)))
+    vals = jnp.asarray([0.0, 0.0, 0.0, 1.0], jnp.float32)
+    sk = dd_update(sk, vals)
+    assert float(sk.zeros) == 3.0
+    assert float(dd_quantile(sk, 0.25)) == 0.0   # rank inside zero bucket
+    est = float(dd_quantile(sk, 1.0))
+    assert abs(est - 1.0) < 0.05
+
+
+def test_mask_and_merge_equals_sequential():
+    rng = np.random.default_rng(1)
+    a = rng.exponential(0.01, 4096).astype(np.float32)
+    b = rng.exponential(0.10, 4096).astype(np.float32)
+    mask = np.ones(4096, bool)
+    mask[2048:] = False  # padding slots must not count
+    sk_a = dd_update(dd_init(), jnp.asarray(a), jnp.asarray(mask))
+    sk_b = dd_update(dd_init(), jnp.asarray(b), jnp.asarray(mask))
+    merged = dd_merge(sk_a, sk_b)
+    seq = dd_update(dd_update(dd_init(), jnp.asarray(a), jnp.asarray(mask)),
+                    jnp.asarray(b), jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(merged.counts),
+                                  np.asarray(seq.counts))
+    assert float(merged.total) == float(mask.sum()) * 2
+    both = np.concatenate([a[:2048], b[:2048]])
+    est = float(dd_quantile(merged, 0.5))
+    true = float(np.quantile(both, 0.5))
+    assert abs(est - true) / true < 0.02
+
+
+def test_cluster_psum_merge_over_mesh():
+    """Per-node latency shards psum-merged == global sketch (the
+    snapshotcombiner role for quantiles)."""
+    rng = np.random.default_rng(2)
+    vals = rng.lognormal(-5.0, 1.0, (8, 2048)).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("node",))
+
+    def update_and_merge(v):
+        sk = dd_update(dd_init(), v)
+        return dd_psum(sk, "node")
+
+    merged = jax.jit(jax.shard_map(
+        update_and_merge, mesh=mesh, in_specs=P("node"),
+        out_specs=P()))(jnp.asarray(vals))
+    est = float(dd_quantile(merged, 0.95))
+    true = float(np.quantile(vals.reshape(-1), 0.95))
+    assert float(merged.total) == vals.size
+    assert abs(est - true) / true < 0.02
+
+
+def test_log2_rebinning_conserves_counts():
+    rng = np.random.default_rng(3)
+    vals = rng.lognormal(-7.0, 1.5, 8192).astype(np.float32)
+    sk = dd_update(dd_init(), jnp.asarray(vals))
+    hist = dd_histogram_log2(sk)
+    assert float(hist.sum()) == float(sk.counts.sum())
+    # mass concentrates around log2(us) of the distribution median
+    med_us = np.quantile(vals, 0.5) * 1e6
+    peak_slot = int(np.argmax(np.asarray(hist)))
+    assert abs(peak_slot - np.log2(med_us)) <= 2.5
