@@ -15,8 +15,10 @@ TPU-first choices: bf16 matmuls (MXU), f32 softmax/layernorm state,
 sinusoidal positions (no learned table → any window length, and under
 sequence parallelism each shard derives its global positions locally),
 attention backend selectable per call: 'full' (short windows),
-'blockwise' (long windows, one chip), 'ring' / 'ulysses' (windows sharded
-over a mesh axis — parallel/ring_attention.py).
+'blockwise' (long windows, one chip), 'flash' (Pallas fused kernel —
+fastest scoring path on long windows, parallel/flash_attention.py),
+'ring' / 'ulysses' (windows sharded over a mesh axis —
+parallel/ring_attention.py).
 """
 
 from __future__ import annotations
@@ -32,9 +34,21 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel.flash_attention import flash_attention
 from ..parallel.ring_attention import (
     blockwise_attention, full_attention, ring_attention, ulysses_attention,
 )
+
+# score-only backends: no VJP through the scratch-carrying Pallas kernel
+_SCORE_ONLY_ATTN = frozenset({"flash"})
+
+
+def _check_trainable_attn(attn: str) -> None:
+    if attn in _SCORE_ONLY_ATTN:
+        raise ValueError(
+            f"attn={attn!r} is a score-only backend (the Pallas kernel has "
+            "no gradient rule); train with 'full', 'blockwise', 'ring' or "
+            "'ulysses' and score with 'flash'")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,6 +139,8 @@ def _sincos_positions(pos, d):
 def _attend(q, k, v, cfg, attn: str, axis_name: str | None):
     if attn == "full":
         return full_attention(q, k, v, causal=True)
+    if attn == "flash":
+        return flash_attention(q, k, v, causal=True)
     if attn == "blockwise":
         t = q.shape[1]
         chunk = next(c for c in range(min(128, t), 0, -1) if t % c == 0)
@@ -205,6 +221,7 @@ def _train_step(params, opt_state, tokens, cfg: SeqConfig, attn: str):
 
 def seq_train_step(scorer: SeqScorer, tokens: jnp.ndarray,
                    attn: str = "full") -> tuple[SeqScorer, jnp.ndarray]:
+    _check_trainable_attn(attn)
     p, o, loss = _train_step(scorer.params, scorer.opt_state, tokens,
                              scorer.config, attn)
     return SeqScorer(params=p, opt_state=o, steps=scorer.steps + 1,
@@ -249,6 +266,7 @@ def make_sp_train_step(mesh: Mesh, cfg: SeqConfig, attn: str = "ring",
                        axis: str = "seq"):
     """Build a jitted sequence-parallel train step: tokens [B, T_global]
     sharded over `axis`, params replicated, grads psum-reduced."""
+    _check_trainable_attn(attn)
     n = mesh.shape[axis]
     opt = _optimizer(cfg)
 
@@ -298,6 +316,7 @@ def make_ep_train_step(mesh: Mesh, cfg: SeqConfig, scorer: SeqScorer,
     grads. Expert grads need no reduction: the all_to_all backprop already
     delivers every rank's contribution to the owning shard. `scorer` is
     only used as the tree template for partition specs."""
+    _check_trainable_attn(attn)
     if not cfg.n_experts:
         raise ValueError("make_ep_train_step requires cfg.n_experts > 0")
     n = mesh.shape[axis]

@@ -18,6 +18,7 @@ from .cluster import (
     ClusterState,
     cluster_init,
 )
+from .flash_attention import flash_attention
 from .moe import make_ep_moe, moe_apply, moe_init, moe_pspecs
 from .pipeline import (
     make_pp_forward,
@@ -32,6 +33,7 @@ __all__ = [
     "cluster_sketch_step", "cluster_merge", "make_cluster_step",
     "ClusterState", "cluster_init",
     "ring_psum", "ring_psum_chunked",
+    "flash_attention",
     "make_ep_moe", "moe_apply", "moe_init", "moe_pspecs",
     "make_pp_forward", "make_pp_train_step", "pp_block_init", "pp_pspecs",
     "pp_reference",
