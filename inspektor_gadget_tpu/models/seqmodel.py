@@ -15,10 +15,10 @@ TPU-first choices: bf16 matmuls (MXU), f32 softmax/layernorm state,
 sinusoidal positions (no learned table → any window length, and under
 sequence parallelism each shard derives its global positions locally),
 attention backend selectable per call: 'full' (short windows),
-'blockwise' (long windows, one chip), 'flash' (Pallas fused kernel —
-fastest scoring path on long windows, parallel/flash_attention.py),
-'ring' / 'ulysses' (windows sharded over a mesh axis —
-parallel/ring_attention.py).
+'blockwise' (long windows, one chip), 'flash' (Pallas fused kernel with a
+blockwise-recompute custom_vjp — fastest long-window path for scoring and
+training, parallel/flash_attention.py), 'ring' / 'ulysses' (windows
+sharded over a mesh axis — parallel/ring_attention.py).
 """
 
 from __future__ import annotations
@@ -39,16 +39,16 @@ from ..parallel.ring_attention import (
     blockwise_attention, full_attention, ring_attention, ulysses_attention,
 )
 
-# score-only backends: no VJP through the scratch-carrying Pallas kernel
-_SCORE_ONLY_ATTN = frozenset({"flash"})
+# backends rejected by training entry points (currently none: 'flash'
+# carries a custom_vjp — fused forward, blockwise-recompute backward)
+_SCORE_ONLY_ATTN: frozenset = frozenset()
 
 
 def _check_trainable_attn(attn: str) -> None:
     if attn in _SCORE_ONLY_ATTN:
         raise ValueError(
-            f"attn={attn!r} is a score-only backend (the Pallas kernel has "
-            "no gradient rule); train with 'full', 'blockwise', 'ring' or "
-            "'ulysses' and score with 'flash'")
+            f"attn={attn!r} is a score-only backend; train with 'full', "
+            "'blockwise', 'ring' or 'ulysses'")
 
 
 @dataclasses.dataclass(frozen=True)
