@@ -14,7 +14,10 @@
 
 #include "sources.cc"
 #include "packet.cc"
+#include "watchers.cc"
 #include "fanotify.cc"
+#include "ptrace_source.cc"
+#include "perf_sampler.cc"
 
 using namespace ig;
 
@@ -34,7 +37,7 @@ Source* lookup(uint64_t h) {
 
 extern "C" {
 
-// Source kinds for ig_source_create.
+// Source kinds for ig_source_create / ig_source_create_cfg.
 enum {
   IG_SRC_SYNTH_EXEC = 1,
   IG_SRC_SYNTH_TCP = 2,
@@ -42,6 +45,13 @@ enum {
   IG_SRC_PROC_EXEC = 100,
   IG_SRC_PROC_TCP = 101,
   IG_SRC_FANOTIFY_EXEC = 102,
+  IG_SRC_FANOTIFY_OPEN = 103,
+  IG_SRC_MOUNTINFO = 104,
+  IG_SRC_SOCK_DIAG = 105,
+  IG_SRC_KMSG_OOM = 106,
+  IG_SRC_PTRACE = 108,
+  IG_SRC_FANOTIFY_RUNC = 109,
+  IG_SRC_PERF_CPU = 110,
   IG_SRC_PKT_DNS = 200,
   IG_SRC_PKT_SNI = 201,
   IG_SRC_PKT_FLOW = 202,
@@ -105,6 +115,82 @@ uint64_t ig_source_create(uint32_t kind, uint64_t seed, double rate,
   uint64_t id = g_next_id++;
   g_sources[id] = s;
   return id;
+}
+
+// String-configured sources ("key=value\x1fkey=value" — the RewriteConstants
+// analogue for sources whose config is not numeric).
+uint64_t ig_source_create_cfg(uint32_t kind, const char* cfg,
+                              uint32_t ring_pow2) {
+  size_t cap = 1ull << (ring_pow2 ? ring_pow2 : 20);
+  std::string c = cfg ? cfg : "";
+  Source* s = nullptr;
+#ifdef __linux__
+  switch (kind) {
+    case IG_SRC_FANOTIFY_OPEN:
+      s = new FanotifyOpenSource(cap, c);
+      break;
+    case IG_SRC_MOUNTINFO:
+      s = new MountInfoSource(cap);
+      break;
+    case IG_SRC_SOCK_DIAG:
+      s = new SockDiagBindSource(cap, c);
+      break;
+    case IG_SRC_KMSG_OOM:
+      s = new KmsgOomSource(cap);
+      break;
+    case IG_SRC_PTRACE:
+      s = new PtraceSyscallSource(cap, c);
+      break;
+    case IG_SRC_FANOTIFY_RUNC:
+      s = new FanotifyRuncSource(cap, c);
+      break;
+    case IG_SRC_PERF_CPU:
+      s = new PerfCpuSampler(cap, c);
+      break;
+    default:
+      return 0;
+  }
+#else
+  (void)cap;
+  return 0;
+#endif
+  std::lock_guard<std::mutex> g(g_mu);
+  uint64_t id = g_next_id++;
+  g_sources[id] = s;
+  return id;
+}
+
+// Capture-side container filter (ref: tracer-collection.go:100-134 mntns
+// map). ids=null clears; n=0 with non-null ids blocks everything.
+int ig_source_set_filter(uint64_t h, const uint64_t* ids, int64_t n) {
+  Source* s = lookup(h);
+  if (!s || n < 0) return -1;
+  s->set_filter(ids, ids ? (size_t)n : 0);
+  return 0;
+}
+
+uint64_t ig_source_filtered(uint64_t h) {
+  Source* s = lookup(h);
+  return s ? s->filtered() : 0;
+}
+
+// Exit status of a ptrace-spawned command (-1 while running, -2 not ptrace).
+int ig_ptrace_exit_status(uint64_t h) {
+#ifdef __linux__
+  Source* s = lookup(h);
+  auto* p = dynamic_cast<PtraceSyscallSource*>(s);
+  return p ? p->exit_status() : -2;
+#else
+  return -2;
+#endif
+}
+
+int ig_perf_supported() {
+#ifdef __linux__
+  return PerfCpuSampler::supported() ? 1 : 0;
+#else
+  return 0;
+#endif
 }
 
 int ig_source_start(uint64_t h) {

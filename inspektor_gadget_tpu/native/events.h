@@ -34,6 +34,8 @@ enum EventKind : uint32_t {
   EV_SNI = 16,
   EV_NET_GRAPH = 17,
   EV_SYSCALL = 18,  // traceloop/seccomp-style raw syscall stream
+  EV_PERF_SAMPLE = 19,  // CPU sampling profiler hit (profile/cpu)
+  EV_CONTAINER = 20,    // container lifecycle from the runc fanotify watch
 };
 
 // 64-byte POD slot; layout is the ring-buffer ABI shared with Python.

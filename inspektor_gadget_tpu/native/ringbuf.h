@@ -51,6 +51,12 @@ class RingBuffer {
   }
 
   uint64_t drops() const { return drops_.load(std::memory_order_relaxed); }
+
+  // Account losses that happened before the ring (e.g. poll-window churn a
+  // scanner provably missed) so downstream gap auditing sees them too.
+  void count_external_drops(uint64_t n) {
+    drops_.fetch_add(n, std::memory_order_relaxed);
+  }
   uint64_t produced() const { return head_.load(std::memory_order_relaxed); }
   uint64_t consumed() const { return tail_.load(std::memory_order_relaxed); }
   size_t size() const {

@@ -24,11 +24,13 @@
 #include <cstring>
 #include <ctime>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <set>
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #ifdef __linux__
@@ -57,8 +59,16 @@ class Vocab {
  public:
   void put(uint64_t h, const char* s, size_t n) {
     std::lock_guard<std::mutex> g(mu_);
+    if (map_.size() >= cap_) return;  // consumers fall back to hex keys
     auto it = map_.find(h);
     if (it == map_.end()) map_.emplace(h, std::string(s, n));
+  }
+
+  // Bound the side table for high-cardinality producers (per-call-unique
+  // syscall lines would otherwise grow it for the life of the source).
+  void set_capacity(size_t cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    cap_ = cap;
   }
   // returns copied length, 0 if unknown
   size_t get(uint64_t h, char* out, size_t cap) {
@@ -73,6 +83,7 @@ class Vocab {
  private:
   std::mutex mu_;
   std::unordered_map<uint64_t, std::string> map_;
+  size_t cap_ = (size_t)-1;
 };
 
 // ---------------------------------------------------------------------------
@@ -99,15 +110,84 @@ class Source {
   size_t pop(Event* out, size_t n) { return ring_.pop(out, n); }
   uint64_t drops() const { return ring_.drops(); }
   uint64_t produced() const { return ring_.produced(); }
+  uint64_t filtered() const {
+    return filtered_.load(std::memory_order_relaxed);
+  }
   Vocab& vocab() { return vocab_; }
+
+  // Capture-side container filter — the mntnsset-map analogue
+  // (ref: pkg/tracer-collection/tracer-collection.go:100-134 keeps a per-
+  // tracer BPF hash of allowed mntns ids so events are discarded *before*
+  // they ever reach userspace). Here the set is swapped in atomically from
+  // the tracer-collection pubsub; capture threads consult it pre-push, so a
+  // filtered gadget does zero per-event Python work and every suppressed
+  // event is accounted.
+  void set_filter(const uint64_t* ids, size_t n) {
+    std::shared_ptr<const std::unordered_set<uint64_t>> f;
+    if (ids != nullptr)
+      f = std::make_shared<const std::unordered_set<uint64_t>>(ids, ids + n);
+    std::lock_guard<std::mutex> g(filter_mu_);
+    filter_ = std::move(f);
+  }
 
  protected:
   virtual void run() = 0;
+
+  // Push through the filter; every event a capture thread emits goes here.
+  bool emit(const Event& ev) {
+    {
+      std::shared_ptr<const std::unordered_set<uint64_t>> f;
+      {
+        std::lock_guard<std::mutex> g(filter_mu_);
+        f = filter_;
+      }
+      if (f && !f->count(ev.mntns)) {
+        filtered_.fetch_add(1, std::memory_order_relaxed);
+        return false;
+      }
+    }
+    return ring_.push(ev);
+  }
+
   RingBuffer ring_;
   Vocab vocab_;
   std::atomic<bool> running_{false};
   std::thread thread_;
+  std::mutex filter_mu_;
+  std::shared_ptr<const std::unordered_set<uint64_t>> filter_;
+  std::atomic<uint64_t> filtered_{0};
 };
+
+#ifdef __linux__
+// Shared /proc identity fill: comm (hashed into the vocab) + mntns.
+// Used by every procfs-adjacent source; the self-enrichment role of the
+// reference's containers-map lookup inside BPF programs.
+inline void fill_proc_identity(Event& ev, Vocab& vocab, uint32_t pid) {
+  char path[64], buf[256];
+  snprintf(path, sizeof(path), "/proc/%u/comm", pid);
+  int fd = open(path, O_RDONLY);
+  ssize_t n = 0;
+  if (fd >= 0) {
+    n = read(fd, buf, sizeof(buf) - 1);
+    close(fd);
+  }
+  if (n > 0 && buf[n - 1] == '\n') n--;
+  if (n > 0) {
+    ev.key_hash = fnv1a64(buf, (size_t)n);
+    vocab.put(ev.key_hash, buf, (size_t)n);
+    size_t c = (size_t)n < sizeof(ev.comm) - 1 ? (size_t)n : sizeof(ev.comm) - 1;
+    memcpy(ev.comm, buf, c);
+  }
+  snprintf(path, sizeof(path), "/proc/%u/ns/mnt", pid);
+  char link[64];
+  ssize_t ln = readlink(path, link, sizeof(link) - 1);
+  if (ln > 0) {
+    link[ln] = 0;
+    const char* lb = strchr(link, '[');
+    if (lb) ev.mntns = strtoull(lb + 1, nullptr, 10);
+  }
+}
+#endif  // __linux__
 
 // ---------------------------------------------------------------------------
 // SyntheticSource — seeded zipf generator over a comm/addr vocabulary.
@@ -180,7 +260,7 @@ class SyntheticSource : public Source {
       carry += per_ms;
       size_t n = (size_t)carry;
       carry -= (double)n;
-      for (size_t i = 0; i < n; i++) ring_.push(make_event());
+      for (size_t i = 0; i < n; i++) emit(make_event());
       std::this_thread::sleep_for(std::chrono::milliseconds(1));
     }
   }
@@ -247,30 +327,14 @@ class ProcExecSource : public Source {
 
  private:
   void fill_from_proc(Event& ev, uint32_t pid) {
-    char path[64], buf[256];
-    snprintf(path, sizeof(path), "/proc/%u/comm", pid);
-    int fd = open(path, O_RDONLY);
-    ssize_t n = 0;
-    if (fd >= 0) {
-      n = read(fd, buf, sizeof(buf) - 1);
-      close(fd);
-    }
-    if (n > 0 && buf[n - 1] == '\n') n--;
-    if (n <= 0) {
-      n = snprintf(buf, sizeof(buf), "pid-%u", pid);
-    }
-    ev.key_hash = fnv1a64(buf, (size_t)n);
-    vocab_.put(ev.key_hash, buf, (size_t)n);
-    size_t c = (size_t)n < sizeof(ev.comm) - 1 ? (size_t)n : sizeof(ev.comm) - 1;
-    memcpy(ev.comm, buf, c);
-    // mntns from /proc/<pid>/ns/mnt symlink: "mnt:[4026531840]"
-    snprintf(path, sizeof(path), "/proc/%u/ns/mnt", pid);
-    char link[64];
-    ssize_t ln = readlink(path, link, sizeof(link) - 1);
-    if (ln > 0) {
-      link[ln] = 0;
-      const char* lb = strchr(link, '[');
-      if (lb) ev.mntns = strtoull(lb + 1, nullptr, 10);
+    fill_proc_identity(ev, vocab_, pid);
+    if (ev.key_hash == 0) {
+      char buf[32];
+      int n = snprintf(buf, sizeof(buf), "pid-%u", pid);
+      ev.key_hash = fnv1a64(buf, (size_t)n);
+      vocab_.put(ev.key_hash, buf, (size_t)n);
+      memcpy(ev.comm, buf, (size_t)n < sizeof(ev.comm) - 1 ? (size_t)n
+                                                           : sizeof(ev.comm) - 1);
     }
   }
 
@@ -330,12 +394,25 @@ class ProcExecSource : public Source {
           ev.kind = EV_EXEC;
           ev.pid = (uint32_t)pe->event_data.exec.process_pid;
           fill_from_proc(ev, ev.pid);
-          ring_.push(ev);
+          emit(ev);
         } else if (pe->what == proc_event::PROC_EVENT_EXIT) {
           ev.kind = EV_EXIT;
           ev.pid = (uint32_t)pe->event_data.exit.process_pid;
           ev.aux2 = (uint64_t)pe->event_data.exit.exit_code;
-          ring_.push(ev);
+          emit(ev);
+          // Termination by signal is kernel-real signal-delivery evidence:
+          // exit_code follows wait(2) encoding, low 7 bits = fatal signal
+          // (sigsnoop's system-wide window without eBPF; the ptrace source
+          // covers full delivery for traced trees).
+          uint32_t sig = (uint32_t)pe->event_data.exit.exit_code & 0x7f;
+          if (sig != 0) {
+            Event sv = ev;
+            sv.kind = EV_SIGNAL;
+            sv.ppid = ev.pid;  // receiver (tpid); sender unknown post-mortem
+            sv.aux2 = sig;
+            sv.aux1 = 1;  // delivered+fatal
+            emit(sv);
+          }
         }
       }
     }
@@ -368,7 +445,7 @@ class ProcExecSource : public Source {
             ev.kind = EV_EXEC;
             ev.pid = pid;
             fill_from_proc(ev, pid);
-            ring_.push(ev);
+            emit(ev);
           }
         }
         for (uint32_t pid : seen) {
@@ -377,7 +454,7 @@ class ProcExecSource : public Source {
             ev.ts_ns = now_ns();
             ev.kind = EV_EXIT;
             ev.pid = pid;
-            ring_.push(ev);
+            emit(ev);
           }
         }
       }
@@ -401,10 +478,12 @@ class ProcTcpSource : public Source {
   void run() override {
     std::map<uint64_t, Event> known;  // inode -> last event
     bool first = true;
+    uint64_t last_opens = 0;
     while (running_.load(std::memory_order_relaxed)) {
       std::map<uint64_t, Event> cur;
       scan("/proc/net/tcp", cur);
       scan("/proc/net/tcp6", cur);
+      size_t new_seen = 0;
       if (!first) {
         for (auto& [inode, ev] : cur) {
           auto it = known.find(inode);
@@ -412,7 +491,8 @@ class ProcTcpSource : public Source {
             Event e = ev;
             // state 0x0A = LISTEN → accept-side socket; else connect
             e.kind = (e.aux2 >> 32) == 0x0A ? EV_TCP_ACCEPT : EV_TCP_CONNECT;
-            ring_.push(e);
+            emit(e);
+            new_seen++;
           }
         }
         for (auto& [inode, ev] : known) {
@@ -420,10 +500,22 @@ class ProcTcpSource : public Source {
             Event e = ev;
             e.kind = EV_TCP_CLOSE;
             e.ts_ns = now_ns();
-            ring_.push(e);
+            emit(e);
           }
         }
       }
+      // Churn accounting: connections opened and closed entirely between
+      // two 50ms scans are invisible to the diff (the reference's kprobe
+      // path sees every connect — tcpconnect.bpf.c). The kernel's SNMP
+      // ActiveOpens+PassiveOpens counters give ground truth; any excess
+      // over sockets we actually observed is surfaced as a drop so the
+      // loss stays auditable end-to-end.
+      uint64_t opens = snmp_tcp_opens();
+      if (last_opens != 0 && opens > last_opens) {
+        uint64_t delta = opens - last_opens;
+        if (delta > new_seen) ring_.count_external_drops(delta - new_seen);
+      }
+      if (opens != 0) last_opens = opens;
       known.swap(cur);
       first = false;
       std::this_thread::sleep_for(std::chrono::milliseconds(50));
@@ -431,6 +523,27 @@ class ProcTcpSource : public Source {
   }
 
  private:
+  // Sum of TCP ActiveOpens + PassiveOpens from /proc/net/snmp.
+  static uint64_t snmp_tcp_opens() {
+    FILE* f = fopen("/proc/net/snmp", "r");
+    if (!f) return 0;
+    char line[1024];
+    uint64_t active = 0, passive = 0;
+    bool header_seen = false;
+    while (fgets(line, sizeof(line), f)) {
+      if (strncmp(line, "Tcp:", 4) != 0) continue;
+      if (!header_seen) {
+        header_seen = true;  // first Tcp: line is the field-name header
+        continue;
+      }
+      // Tcp: RtoAlgorithm RtoMin RtoMax MaxConn ActiveOpens PassiveOpens ...
+      sscanf(line, "Tcp: %*s %*s %*s %*s %llu %llu",
+             (unsigned long long*)&active, (unsigned long long*)&passive);
+      break;
+    }
+    fclose(f);
+    return active + passive;
+  }
   void scan(const char* path, std::map<uint64_t, Event>& out) {
     FILE* f = fopen(path, "r");
     if (!f) return;
