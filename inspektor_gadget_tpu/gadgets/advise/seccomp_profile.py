@@ -48,17 +48,37 @@ def generate_oci_seccomp_profile(syscalls: set[str],
 
 
 class AdviseSeccompProfile(SourceTraceGadget):
-    native_kind = None
+    """Native mode records the target's ACTUAL syscall numbers from the
+    ptrace stream (EV_SYSCALL aux2 high word = nr), so the generated
+    profile is exactly the syscall set the workload exercised — the
+    contract of the reference's per-mntns bitmap Peek (tracer.go:107)."""
+
+    native_kind = B.SRC_PTRACE
     synth_kind = B.SRC_SYNTH_EXEC
+    kind_filter = (18,)  # EV_SYSCALL
 
     def __init__(self, ctx):
         super().__init__(ctx)
+        p = ctx.gadget_params
+        self._command = p.get("command").as_string() if "command" in p else ""
+        self._target_pid = p.get("pid").as_int() if "pid" in p else 0
         self._per_container: dict[int, set[int]] = defaultdict(set)
+
+    def native_ready(self) -> bool:
+        return bool(self._command or self._target_pid)
+
+    def native_cfg(self) -> str:
+        import shlex
+        if self._command:
+            return B.make_cfg(cmd=shlex.split(self._command))
+        return B.make_cfg(pid=self._target_pid)
 
     def process_batch(self, batch) -> None:
         c = batch.cols
         for i in range(batch.count):
-            self._per_container[int(c["mntns"][i])].add(int(c["aux2"][i]) % 335)
+            aux2 = int(c["aux2"][i])
+            nr = (aux2 >> 32) if self._is_native else aux2 % 335
+            self._per_container[int(c["mntns"][i])].add(nr)
 
     def run_with_result(self, ctx) -> bytes:
         self.run(ctx)  # records until timeout/cancel
@@ -82,6 +102,10 @@ class AdviseSeccompProfileDesc(GadgetDesc):
         p = source_params()
         p.append(ParamDesc(key="profile-name", default="",
                            description="name for the generated profile"))
+        p.append(ParamDesc(key="command", default="",
+                           description="command to spawn and record"))
+        p.append(ParamDesc(key="pid", default="0",
+                           description="existing pid to attach to"))
         return p
 
     def new_instance(self, ctx) -> AdviseSeccompProfile:

@@ -2,18 +2,24 @@
 
 Reference: pkg/gadgets/audit/seccomp (audit-seccomp.bpf.c kprobe on
 audit_seccomp; reports pid/comm/syscall/code e.g. SECCOMP_RET_KILL).
-Without a kprobe window this runs on the synthetic syscall stream; the
-schema, the code decoding, and container filtering match.
+Native window here: the ptrace syscall stream of a traced target
+(--command/--pid). Two real seccomp outcomes are observable on it:
+  - SECCOMP_RET_ERRNO: the denied syscall returns -EPERM at its exit stop
+    (EV_SYSCALL with ret == -1) → code ERRNO;
+  - SECCOMP_RET_KILL/TRAP: the tracee takes SIGSYS, seen as a
+    signal-delivery-stop (EV_SIGNAL sig=31) → code KILL_THREAD.
+The synthetic stream remains for demos; rows from it carry code SYNTH.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import shlex
 
 import numpy as np
 
 from ...columns import col
-from ...params import ParamDescs
+from ...params import ParamDesc, ParamDescs, TypeHint
 from ...types import Event, WithMountNsID
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
@@ -21,8 +27,9 @@ from ..source_gadget import SourceTraceGadget, source_params
 from ...sources import bridge as B
 from ...utils.syscalls import syscall_name
 
-_CODES = {0: "KILL_THREAD", 1: "KILL_PROCESS", 2: "TRAP", 3: "ERRNO",
-          4: "USER_NOTIF", 5: "TRACE", 6: "LOG"}
+EV_SIGNAL, EV_SYSCALL = 9, 18
+_EPERM, _EACCES = 1, 13
+_SIGSYS = 31
 
 
 @dataclasses.dataclass
@@ -34,17 +41,61 @@ class SeccompEvent(Event, WithMountNsID):
 
 
 class AuditSeccomp(SourceTraceGadget):
-    native_kind = None
+    native_kind = B.SRC_PTRACE
     synth_kind = B.SRC_SYNTH_EXEC
+    kind_filter = (EV_SYSCALL, EV_SIGNAL)
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        p = ctx.gadget_params
+        self._command = p.get("command").as_string() if "command" in p else ""
+        self._target_pid = p.get("pid").as_int() if "pid" in p else 0
+
+    def native_ready(self) -> bool:
+        return bool(self._command or self._target_pid)
+
+    def native_cfg(self) -> str:
+        if self._command:
+            return B.make_cfg(cmd=shlex.split(self._command))
+        return B.make_cfg(pid=self._target_pid)
+
+    def _decode_real(self, batch, i):
+        c = batch.cols
+        kind = int(c["kind"][i])
+        if kind == EV_SIGNAL:
+            if int(c["aux2"][i]) != _SIGSYS:
+                return None
+            return SeccompEvent(
+                timestamp=int(c["ts"][i]), mountnsid=int(c["mntns"][i]),
+                pid=int(c["pid"][i]), comm=batch.comm_str(i),
+                syscall="?", code="KILL_THREAD")
+        aux2 = int(c["aux2"][i])
+        ret = aux2 & 0xFFFFFFFF
+        if ret >= 0x80000000:
+            ret -= 1 << 32
+        if ret not in (-_EPERM, -_EACCES):
+            return None
+        return SeccompEvent(
+            timestamp=int(c["ts"][i]), mountnsid=int(c["mntns"][i]),
+            pid=int(c["pid"][i]), comm=batch.comm_str(i),
+            syscall=syscall_name(aux2 >> 32), code="ERRNO")
 
     def decode_row(self, batch, i):
+        if self._is_native:
+            return self._decode_real(batch, i)
         c = batch.cols
         return SeccompEvent(
             timestamp=int(c["ts"][i]), mountnsid=int(c["mntns"][i]),
             pid=int(c["pid"][i]), comm=batch.comm_str(i),
             syscall=syscall_name(int(c["aux2"][i]) % 335),
-            code=_CODES.get(int(c["aux1"][i]) % 7, "LOG"),
-        )
+            code="SYNTH")
+
+    def run(self, ctx):
+        # denied-only stream: drop the None rows decode_row filters out
+        orig = self._event_handler
+        if orig is not None:
+            self._event_handler = lambda ev: orig(ev) if ev is not None else None
+        super().run(ctx)
 
 
 @register
@@ -52,11 +103,15 @@ class AuditSeccompDesc(GadgetDesc):
     name = "seccomp"
     category = "audit"
     gadget_type = GadgetType.TRACE
-    description = "Audit seccomp filter actions"
+    description = "Audit seccomp filter actions (denied syscalls/SIGSYS)"
     event_cls = SeccompEvent
 
     def params(self) -> ParamDescs:
-        return source_params()
+        p = source_params()
+        p.append(ParamDesc(key="command", default="",
+                           description="command to spawn and trace"))
+        p.append(ParamDesc(key="pid", default="0", type_hint=TypeHint.INT))
+        return p
 
     def new_instance(self, ctx) -> AuditSeccomp:
         return AuditSeccomp(ctx)

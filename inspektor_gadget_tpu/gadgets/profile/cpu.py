@@ -3,11 +3,14 @@
 Reference: pkg/gadgets/profile/cpu (profile.bpf.c perf-event sampling at
 49 Hz into a stack map, stack depth 127; tracer.go:139 kallsyms
 symbolization, :293-322 collectResult, :324-402 folded/flamegraph output;
-RunWithResult). Native analogue without a BPF stack walker: sample at 49 Hz
-from /proc — per-pid utime+stime deltas attribute samples to processes, and
-/proc/<pid>/stack (root) supplies already-symbolized kernel stacks for
-on-CPU-in-kernel samples. Output formats: columns (sample counts per comm)
-and folded (flamegraph.pl-compatible "comm;frameN;...;frame1 count").
+RunWithResult). Primary path: the SAME perf_event_open window the
+reference uses — native/perf_sampler.cc samples CPU-clock at 49 Hz per
+CPU with PERF_SAMPLE_CALLCHAIN, symbolizes kernel frames from kallsyms and
+attributes user frames to their mapping; each EV_PERF_SAMPLE's vocab
+payload is the folded stack. Fallback (perf unavailable): 49 Hz procfs
+scan — per-pid utime+stime jiffy deltas + /proc/<pid>/stack kernel frames
+(the standardgadgets-style degraded flavour; sample counts are jiffy
+deltas there, disclosed in the output header).
 """
 
 from __future__ import annotations
@@ -24,9 +27,12 @@ from ...params import ParamDesc, ParamDescs, TypeHint
 from ...types import Event, WithMountNsID
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
+from ...sources import bridge as B
+from ...sources.bridge import NativeCapture, native_available
 
 SAMPLE_HZ = 49          # ref: tracer.go:57
 MAX_STACK_DEPTH = 127   # ref: tracer.go:58
+EV_PERF_SAMPLE = 19
 
 
 @dataclasses.dataclass
@@ -69,12 +75,69 @@ class ProfileCpu:
         self.kernel_only = p.get("kernel").as_bool() if "kernel" in p else False
         self.fmt = p.get("profile-output").as_string() if "profile-output" in p else "columns"
         self.target_pid = p.get("pid").as_int() if "pid" in p else 0
+        self._mode = p.get("sampler").as_string() if "sampler" in p else "auto"
         self._mntns_filter: set[int] | None = None
 
     def set_mntns_filter(self, mntns_ids):
         self._mntns_filter = mntns_ids
 
+    # -- perf_event_open path (the reference's own window) ------------------
+
+    def _perf_available(self) -> bool:
+        if not native_available():
+            return False
+        from ...sources.bridge import _load
+        lib = _load()
+        return bool(lib is not None and lib.ig_perf_supported())
+
+    def _run_perf(self, ctx) -> bytes:
+        cfg = B.make_cfg(freq=SAMPLE_HZ, pid=self.target_pid or None,
+                         user=1 if self.user_only else None,
+                         kernel=1 if self.kernel_only else None)
+        src = NativeCapture(B.SRC_PERF_CPU, cfg=cfg, ring_pow2=16)
+        src.start()
+        folded: Counter[str] = Counter()
+        samples_by_comm: Counter[str] = Counter()
+        try:
+            while not ctx.done:
+                b = src.pop()
+                if b.count == 0:
+                    if ctx.sleep_or_done(0.02):
+                        break
+                    continue
+                c = b.cols
+                for i in range(b.count):
+                    if int(c["kind"][i]) != EV_PERF_SAMPLE:
+                        continue
+                    if (self._mntns_filter is not None
+                            and int(c["mntns"][i]) not in self._mntns_filter):
+                        continue
+                    stack = src.vocab_lookup(int(c["key_hash"][i]))
+                    if not stack:
+                        stack = f"pid-{int(c['pid'][i])}"
+                    folded[stack] += 1
+                    samples_by_comm[stack.split(";", 1)[0]] += 1
+        finally:
+            src.stop()
+            src.close()
+        if self.fmt == "folded":
+            lines = [f"{path} {n}" for path, n in sorted(folded.items())]
+            return ("\n".join(lines) + "\n").encode()
+        from ...columns import Columns
+        from ..render import render_result
+        rows = [CpuSample(comm=comm, samples=n)
+                for comm, n in samples_by_comm.most_common(50)]
+        cols = Columns(CpuSample)
+        cols.hide_tagged(["kubernetes"])
+        return render_result(ctx, rows, cols)
+
+    # -- procfs fallback ----------------------------------------------------
+
     def run_with_result(self, ctx) -> bytes:
+        if self._mode in ("auto", "perf") and self._perf_available():
+            return self._run_perf(ctx)
+        if self._mode == "perf":
+            raise RuntimeError("perf_event_open unavailable")
         stacks: Counter[tuple[str, tuple[str, ...]]] = Counter()
         comms: dict[int, str] = {}
         prev: dict[int, int] = {}
@@ -146,6 +209,9 @@ class ProfileCpuDesc(GadgetDesc):
             ParamDesc(key="pid", default="0", type_hint=TypeHint.INT),
             ParamDesc(key="profile-output", default="columns",
                       possible_values=("columns", "folded")),
+            ParamDesc(key="sampler", default="auto",
+                      possible_values=("auto", "perf", "procfs"),
+                      description="perf_event_open or procfs fallback"),
         ])
 
     def new_instance(self, ctx) -> ProfileCpu:

@@ -39,16 +39,20 @@ def source_params() -> ParamDescs:
 
 class SourceTraceGadget:
     """Concrete subclasses set: native_kind (proc capture), synth_kind
-    (synthetic), decode_row(batch, i) -> event."""
+    (synthetic), decode_row(batch, i) -> event. kind_filter restricts the
+    stream to the gadget's event kinds when the source multiplexes several
+    (e.g. the ptrace stream carries syscalls + signals + capabilities)."""
 
     native_kind: int | None = None
     synth_kind: int = 1
+    kind_filter: tuple[int, ...] | None = None
 
     def __init__(self, ctx: GadgetContext):
         self.ctx = ctx
         self._event_handler: Callable[[Any], None] | None = None
         self._batch_handler: Callable[[EventBatch], None] | None = None
         self._mntns_filter: set[int] | None = None
+        self._is_native = False
         p = ctx.gadget_params
         self._mode = p.get("source").as_string() if "source" in p else "auto"
         self._rate = p.get("rate").as_float() if "rate" in p else 100000.0
@@ -68,13 +72,30 @@ class SourceTraceGadget:
 
     def set_mntns_filter(self, mntns_ids: set[int] | None) -> None:
         self._mntns_filter = mntns_ids
+        # live update: push into the C++ capture layer so filtering happens
+        # before the ring, not on the Python display path (ref:
+        # tracer-collection.go:100-134 mntnsset map updates)
+        src = self.source
+        if src is not None and isinstance(src, NativeCapture):
+            src.set_filter(mntns_ids)
 
     # source selection ------------------------------------------------------
+
+    def native_cfg(self) -> str:
+        """Config string for cfg-kind native sources; subclasses override
+        to pass command/pid/thresholds (see sources.bridge.make_cfg)."""
+        return ""
+
+    def native_ready(self) -> bool:
+        """Whether the native source can run (e.g. ptrace-backed gadgets
+        need a command/pid target). Auto mode falls back to synthetic when
+        not ready; explicit native mode raises."""
+        return self.native_kind is not None
 
     def _make_source(self):
         mode = self._mode
         if mode == "auto":
-            if self.native_kind is not None and native_available():
+            if self.native_ready() and native_available():
                 mode = "native"
             elif native_available():
                 mode = "synthetic"
@@ -84,16 +105,26 @@ class SourceTraceGadget:
             if self.native_kind is None or not native_available():
                 raise RuntimeError(
                     f"{type(self).__name__}: native capture unavailable")
+            if not self.native_ready():
+                raise RuntimeError(
+                    f"{type(self).__name__}: native source needs a target "
+                    "(--command/--pid)")
             src = NativeCapture(self.native_kind, ring_pow2=20,
-                                batch_size=self._batch_size)
+                                batch_size=self._batch_size,
+                                cfg=self.native_cfg())
+            if self._mntns_filter is not None:
+                src.set_filter(self._mntns_filter)
             src.start()
             self._threaded = True
+            self._is_native = True
             return src
         if mode == "synthetic":
             src = NativeCapture(self.synth_kind, seed=self._seed,
                                 rate=self._rate, vocab=self._vocab,
                                 zipf_s=self._zipf, ring_pow2=20,
                                 batch_size=self._batch_size)
+            if self._mntns_filter is not None:
+                src.set_filter(self._mntns_filter)
             src.start()
             self._threaded = True
             return src
@@ -111,9 +142,12 @@ class SourceTraceGadget:
             while not ctx.done and not deadline_hit:
                 batch = self.source.pop()
                 if batch.count == 0:
+                    if self._source_done():
+                        break  # e.g. traced command exited, ring drained
                     if ctx.sleep_or_done(0.01):
                         break
                     continue
+                self._apply_kind_filter(batch)
                 self._apply_filter(batch)
                 if batch.count:
                     self.process_batch(batch)
@@ -133,21 +167,46 @@ class SourceTraceGadget:
             except Exception:
                 pass
 
-    def _apply_filter(self, batch: EventBatch) -> None:
-        """Compact the batch to rows whose mntns passes the filter — the
-        userspace analogue of the BPF-side filter_by_mnt_ns constant
-        (ref: execsnoop.bpf.c:10-35 const volatile + map lookup)."""
-        if self._mntns_filter is None or batch.count == 0:
-            return
-        mntns = batch.cols["mntns"][: batch.count]
-        allowed = np.isin(mntns, np.fromiter(self._mntns_filter, dtype=np.uint64)
-                          if self._mntns_filter else np.array([], dtype=np.uint64))
-        keep = np.flatnonzero(allowed)
-        for name, arr in batch.cols.items():
+    def _source_done(self) -> bool:
+        """True when the source will never produce again (a ptrace-spawned
+        command has exited and its ring is drained)."""
+        from ..sources.bridge import SRC_PTRACE
+        src = self.source
+        if (self._is_native and isinstance(src, NativeCapture)
+                and src.kind == SRC_PTRACE):
+            return src.ptrace_exit_status() >= 0
+        return False
+
+    @staticmethod
+    def _compact(batch: EventBatch, keep: np.ndarray) -> None:
+        for _name, arr in batch.cols.items():
             arr[: len(keep)] = arr[keep]
         if batch.comm is not None:
             batch.comm[: len(keep)] = batch.comm[keep]
         batch.count = len(keep)
+
+    def _apply_kind_filter(self, batch: EventBatch) -> None:
+        # Only native sources multiplex kinds; synthetic streams carry one
+        # fabricated kind that stands in for the gadget's own.
+        if self.kind_filter is None or batch.count == 0 or not self._is_native:
+            return
+        kinds = batch.cols["kind"][: batch.count]
+        keep = np.flatnonzero(np.isin(
+            kinds, np.asarray(self.kind_filter, dtype=kinds.dtype)))
+        if len(keep) != batch.count:
+            self._compact(batch, keep)
+
+    def _apply_filter(self, batch: EventBatch) -> None:
+        """Python-side mntns compaction — only needed for the pysynthetic
+        source; native sources filter in the capture thread (set_filter)."""
+        if self._mntns_filter is None or batch.count == 0:
+            return
+        if self._threaded:
+            return  # already filtered at capture
+        mntns = batch.cols["mntns"][: batch.count]
+        allowed = np.isin(mntns, np.fromiter(self._mntns_filter, dtype=np.uint64)
+                          if self._mntns_filter else np.array([], dtype=np.uint64))
+        self._compact(batch, np.flatnonzero(allowed))
 
     def process_batch(self, batch: EventBatch) -> None:
         """Internal hook run on every batch regardless of external handlers

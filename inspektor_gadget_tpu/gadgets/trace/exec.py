@@ -34,6 +34,7 @@ class ExecEvent(Event, WithMountNsID):
 class TraceExec(SourceTraceGadget):
     native_kind = SRC_PROC_EXEC
     synth_kind = SRC_SYNTH_EXEC
+    kind_filter = (1, 2)  # EV_EXEC, EV_EXIT (the source also emits EV_SIGNAL)
 
     def decode_row(self, batch, i) -> ExecEvent:
         c = batch.cols

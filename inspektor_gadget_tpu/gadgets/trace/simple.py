@@ -1,19 +1,31 @@
 """trace/{open,mount,signal,oomkill,capabilities,bind,fsslower} — the
-syscall-family trace gadgets.
+syscall-family trace gadgets, each backed by a real kernel window.
 
 Reference (pkg/gadgets/trace/*): opensnoop.bpf.c (openat tracepoints),
 mountsnoop.bpf.c, sigsnoop.bpf.c, oomkill.bpf.c (kprobe oom_kill_process),
 capable.bpf.c (kprobe cap_capable), bindsnoop.bpf.c, fsslower.bpf.c —
-each ~150-250 LoC BPF + ~200-290 LoC Go tracer. Here each gadget is a
-schema + row decoder over the shared capture pipeline; the synthetic
-source provides deterministic streams for every kind, and the netlink/
-procfs exec source feeds lifecycle-adjacent kinds where the kernel offers
-a non-BPF window.
+each ~150-250 LoC BPF + ~200-290 LoC Go tracer. Here each gadget decodes a
+real non-BPF capture source (native/watchers.cc, native/ptrace_source.cc):
+
+  open          fanotify mount marks (FAN_OPEN|FAN_MODIFY, path via fd)
+  mount         pollable /proc/self/mountinfo diffs
+  bind          sock_diag dumps + /proc/net/udp, inode→pid resolution
+  oomkill       /dev/kmsg OOM-killer records
+  signal        netlink exit records (fatal signals, system-wide) and the
+                ptrace stream (full delivery + sender side) when a
+                --command/--pid target is given
+  capabilities  ptrace stream — capability-implying syscalls with the
+                verdict observed from the outcome (needs --command/--pid)
+  fsslower      ptrace stream — entry/exit latency per fs op (needs target)
+
+The synthetic source remains available for benches/demos; decoders branch
+on the event kind so fabricated rows are never presented as captures.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import shlex
 
 import numpy as np
 
@@ -24,6 +36,10 @@ from ..interface import GadgetDesc, GadgetType
 from ..registry import register
 from ..source_gadget import SourceTraceGadget, source_params
 from ...sources import bridge as B
+
+# EventKind values (native/events.h)
+EV_OPEN, EV_BIND, EV_SIGNAL, EV_MOUNT, EV_OOMKILL = 3, 8, 9, 10, 11
+EV_CAPABILITY, EV_FSSLOWER, EV_SYSCALL = 12, 13, 18
 
 
 @dataclasses.dataclass
@@ -42,16 +58,37 @@ def _base_fields(g, batch, i, cls, **kw):
     )
 
 
-def _simple_gadget(gname: str, desc_text: str, event_cls, decode, synth_kind: int,
-                   extra_params: list[ParamDesc] | None = None):
-    """Build + register a capture-backed trace gadget."""
+class _PtraceTargetMixin:
+    """Gadgets whose native window is the ptrace stream need a target
+    (matching the reference's traceloop per-container attach model)."""
 
-    gadget_cls = type(f"Trace{gname.title()}", (SourceTraceGadget,), {
-        "native_kind": None,
-        "synth_kind": synth_kind,
-        "decode_row": decode,
-    })
+    def _target_params(self):
+        p = self.ctx.gadget_params
+        self._command = p.get("command").as_string() if "command" in p else ""
+        self._target_pid = p.get("pid").as_int() if "pid" in p else 0
 
+    def native_ready(self) -> bool:
+        return bool(getattr(self, "_command", "") or
+                    getattr(self, "_target_pid", 0))
+
+    def native_cfg(self) -> str:
+        kw = {}
+        if self._command:
+            kw["cmd"] = shlex.split(self._command)
+        elif self._target_pid:
+            kw["pid"] = self._target_pid
+        return B.make_cfg(**kw)
+
+
+_TARGET_PARAMS = [
+    ParamDesc(key="command", default="",
+              description="command to spawn and trace (ptrace window)"),
+    ParamDesc(key="pid", default="0", type_hint=TypeHint.INT,
+              description="existing pid to attach to"),
+]
+
+
+def _register(gname, desc_text, event_cls, gadget_cls, extra_params=None):
     def _params(self) -> ParamDescs:
         p = source_params()
         if extra_params:
@@ -75,23 +112,44 @@ def _simple_gadget(gname: str, desc_text: str, event_cls, decode, synth_kind: in
 
 @dataclasses.dataclass
 class OpenEvent(_Base):
-    fd: int = col(0, width=4, dtype=np.int32)
+    op: str = col("", width=6)
     ret: int = col(0, width=4, dtype=np.int32)
     flags: int = col(0, width=8, hide=True, dtype=np.int32)
-    mode: int = col(0, width=6, hide=True, dtype=np.int32)
     path: str = col("", width=32, ellipsis="start")
 
 
-def _decode_open(self, batch, i):
-    c = batch.cols
-    aux2 = int(c["aux2"][i])
-    return _base_fields(self, batch, i, OpenEvent,
-                        fd=aux2 & 0xFFFF, ret=(aux2 >> 16) & 0xFF,
-                        flags=int(c["aux1"][i]) & 0xFFFFF,
-                        path=self.resolve_key(int(c["key_hash"][i])))
+class TraceOpen(SourceTraceGadget):
+    native_kind = B.SRC_FANOTIFY_OPEN
+    synth_kind = B.SRC_SYNTH_EXEC
+    kind_filter = (EV_OPEN,)
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        p = ctx.gadget_params
+        self._paths = p.get("paths").as_string() if "paths" in p else "/"
+
+    def native_cfg(self) -> str:
+        return B.make_cfg(paths=self._paths, modify=1)
+
+    def decode_row(self, batch, i):
+        c = batch.cols
+        if int(c["kind"][i]) == EV_OPEN:  # real fanotify capture
+            mask = int(c["aux2"][i])
+            return _base_fields(self, batch, i, OpenEvent,
+                                op="write" if mask & 2 else "read",
+                                ret=0, flags=mask,
+                                path=self.resolve_key(int(c["aux1"][i])))
+        aux2 = int(c["aux2"][i])  # synthetic stand-in
+        return _base_fields(self, batch, i, OpenEvent,
+                            op="read", ret=(aux2 >> 16) & 0xFF,
+                            flags=int(c["aux1"][i]) & 0xFFFFF,
+                            path=self.resolve_key(int(c["key_hash"][i])))
 
 
-_simple_gadget("open", "Trace open() calls", OpenEvent, _decode_open, B.SRC_SYNTH_EXEC)
+_register("open", "Trace file opens (fanotify mount marks)", OpenEvent,
+          TraceOpen,
+          [ParamDesc(key="paths", default="/",
+                     description="colon-separated mounts to watch")])
 
 
 # -- trace/mount (ref: mountsnoop.bpf.c 168) --------------------------------
@@ -99,67 +157,121 @@ _simple_gadget("open", "Trace open() calls", OpenEvent, _decode_open, B.SRC_SYNT
 @dataclasses.dataclass
 class MountEvent(_Base):
     operation: str = col("", width=7)
-    source: str = col("", width=24)
-    target: str = col("", width=24, hide=True)
-    ret: int = col(0, width=4, dtype=np.int32)
+    source: str = col("", width=20)
+    target: str = col("", width=24)
+    fstype: str = col("", width=8)
 
 
-def _decode_mount(self, batch, i):
-    c = batch.cols
-    return _base_fields(self, batch, i, MountEvent,
-                        operation="mount" if int(c["aux2"][i]) % 2 == 0 else "umount",
-                        source=self.resolve_key(int(c["key_hash"][i])),
-                        ret=0)
+class TraceMount(SourceTraceGadget):
+    native_kind = B.SRC_MOUNTINFO
+    synth_kind = B.SRC_SYNTH_EXEC
+    kind_filter = (EV_MOUNT,)
+
+    def decode_row(self, batch, i):
+        c = batch.cols
+        if int(c["kind"][i]) == EV_MOUNT:  # real mountinfo diff
+            payload = self.resolve_key(int(c["key_hash"][i]))
+            src, _, rest = payload.partition("\x1f")
+            target, _, fstype = rest.partition("\x1f")
+            return _base_fields(self, batch, i, MountEvent,
+                                operation="umount" if int(c["aux2"][i]) & 1
+                                else "mount",
+                                source=src, target=target, fstype=fstype)
+        return _base_fields(self, batch, i, MountEvent,
+                            operation="mount" if int(c["aux2"][i]) % 2 == 0
+                            else "umount",
+                            source=self.resolve_key(int(c["key_hash"][i])),
+                            target="", fstype="")
 
 
-_simple_gadget("mount", "Trace mount/umount", MountEvent, _decode_mount,
-               B.SRC_SYNTH_EXEC)
+_register("mount", "Trace mount/umount (mountinfo diffs)", MountEvent,
+          TraceMount)
 
 
 # -- trace/signal (ref: sigsnoop.bpf.c 175) ---------------------------------
 
-_SIGNAMES = {1: "SIGHUP", 2: "SIGINT", 9: "SIGKILL", 11: "SIGSEGV",
-             15: "SIGTERM", 17: "SIGCHLD", 13: "SIGPIPE"}
+_SIGNAMES = {1: "SIGHUP", 2: "SIGINT", 3: "SIGQUIT", 4: "SIGILL", 5: "SIGTRAP",
+             6: "SIGABRT", 7: "SIGBUS", 8: "SIGFPE", 9: "SIGKILL",
+             10: "SIGUSR1", 11: "SIGSEGV", 12: "SIGUSR2", 13: "SIGPIPE",
+             14: "SIGALRM", 15: "SIGTERM", 17: "SIGCHLD", 19: "SIGSTOP",
+             31: "SIGSYS"}
 
 
 @dataclasses.dataclass
 class SignalEvent(_Base):
     signal: str = col("", width=9)
     tpid: int = col(0, template="pid", dtype=np.int32)
-    ret: int = col(0, width=4, dtype=np.int32)
+    origin: str = col("", width=9)  # sent / deliver / fatal
 
 
-def _decode_signal(self, batch, i):
-    c = batch.cols
-    sig = int(c["aux2"][i]) % 31 + 1
-    return _base_fields(self, batch, i, SignalEvent,
-                        signal=_SIGNAMES.get(sig, str(sig)),
-                        tpid=int(c["ppid"][i]), ret=0)
+class TraceSignal(_PtraceTargetMixin, SourceTraceGadget):
+    """Native windows: netlink exits (fatal signals, system-wide) by
+    default; the ptrace stream (full sigsnoop semantics) with a target."""
+
+    native_kind = B.SRC_PROC_EXEC
+    synth_kind = B.SRC_SYNTH_EXEC
+    kind_filter = (EV_SIGNAL,)
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._target_params()
+        if self.native_ready():
+            self.native_kind = B.SRC_PTRACE
+
+    # netlink mode needs no target; ptrace mode requires one
+    def native_ready(self) -> bool:  # noqa: D102
+        return True
+
+    def native_cfg(self) -> str:
+        if self.native_kind == B.SRC_PTRACE:
+            return _PtraceTargetMixin.native_cfg(self)
+        return ""
+
+    def decode_row(self, batch, i):
+        c = batch.cols
+        if int(c["kind"][i]) == EV_SIGNAL:  # real capture
+            sig = int(c["aux2"][i])
+            origin = {0: "deliver", 1: "fatal", 2: "sent"}.get(
+                int(c["aux1"][i]), "deliver")
+            return _base_fields(self, batch, i, SignalEvent,
+                                signal=_SIGNAMES.get(sig, str(sig)),
+                                tpid=int(c["ppid"][i]), origin=origin)
+        sig = int(c["aux2"][i]) % 31 + 1  # synthetic stand-in
+        return _base_fields(self, batch, i, SignalEvent,
+                            signal=_SIGNAMES.get(sig, str(sig)),
+                            tpid=int(c["ppid"][i]), origin="synth")
 
 
-_simple_gadget("signal", "Trace signal delivery", SignalEvent, _decode_signal,
-               B.SRC_SYNTH_EXEC)
+_register("signal", "Trace signal delivery (exits/ptrace)", SignalEvent,
+          TraceSignal, _TARGET_PARAMS)
 
 
 # -- trace/oomkill (ref: oomkill.bpf.c 51) ----------------------------------
 
 @dataclasses.dataclass
 class OomKillEvent(_Base):
-    kpid: int = col(0, template="pid", dtype=np.int32)
-    kcomm: str = col("", template="comm")
+    kcomm: str = col("", template="comm")  # trigger ("invoked oom-killer")
     pages: int = col(0, width=8, dtype=np.int64)
 
 
-def _decode_oom(self, batch, i):
-    c = batch.cols
-    return _base_fields(self, batch, i, OomKillEvent,
-                        kpid=int(c["pid"][i]),
-                        kcomm=batch.comm_str(i),
-                        pages=int(c["aux1"][i]) & 0xFFFFF)
+class TraceOomKill(SourceTraceGadget):
+    native_kind = B.SRC_KMSG_OOM
+    synth_kind = B.SRC_SYNTH_EXEC
+    kind_filter = (EV_OOMKILL,)
+
+    def decode_row(self, batch, i):
+        c = batch.cols
+        if int(c["kind"][i]) == EV_OOMKILL:  # real kmsg record
+            return _base_fields(self, batch, i, OomKillEvent,
+                                kcomm=self.resolve_key(int(c["aux2"][i])),
+                                pages=int(c["aux1"][i]))
+        return _base_fields(self, batch, i, OomKillEvent,
+                            kcomm=batch.comm_str(i),
+                            pages=int(c["aux1"][i]) & 0xFFFFF)
 
 
-_simple_gadget("oomkill", "Trace OOM killer", OomKillEvent, _decode_oom,
-               B.SRC_SYNTH_EXEC)
+_register("oomkill", "Trace the OOM killer (kmsg)", OomKillEvent,
+          TraceOomKill)
 
 
 # -- trace/capabilities (ref: capable.bpf.c 250) ----------------------------
@@ -182,18 +294,34 @@ class CapabilityEvent(_Base):
     verdict: str = col("", width=7)
 
 
-def _decode_cap(self, batch, i):
-    c = batch.cols
-    capid = int(c["aux2"][i]) % len(_CAPS)
-    return _base_fields(self, batch, i, CapabilityEvent,
-                        cap=_CAPS[capid], audit=True,
-                        verdict="allow" if int(c["aux1"][i]) % 4 else "deny")
+class TraceCapabilities(_PtraceTargetMixin, SourceTraceGadget):
+    native_kind = B.SRC_PTRACE
+    synth_kind = B.SRC_SYNTH_EXEC
+    kind_filter = (EV_CAPABILITY,)
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._target_params()
+
+    def decode_row(self, batch, i):
+        c = batch.cols
+        if int(c["kind"][i]) == EV_CAPABILITY:  # real (outcome-observed)
+            capid = int(c["aux2"][i])
+            return _base_fields(self, batch, i, CapabilityEvent,
+                                cap=_CAPS[capid] if capid < len(_CAPS)
+                                else str(capid),
+                                audit=True,
+                                verdict="allow" if int(c["aux1"][i]) else "deny")
+        capid = int(c["aux2"][i]) % len(_CAPS)
+        return _base_fields(self, batch, i, CapabilityEvent,
+                            cap=_CAPS[capid], audit=True,
+                            verdict="allow" if int(c["aux1"][i]) % 4 else "deny")
 
 
-_simple_gadget("capabilities", "Trace capability checks", CapabilityEvent,
-               _decode_cap, B.SRC_SYNTH_EXEC,
-               [ParamDesc(key="audit-only", default="true",
-                          type_hint=TypeHint.BOOL)])
+_register("capabilities", "Trace capability exercises (ptrace)",
+          CapabilityEvent, TraceCapabilities,
+          _TARGET_PARAMS + [ParamDesc(key="audit-only", default="true",
+                                      type_hint=TypeHint.BOOL)])
 
 
 # -- trace/bind (ref: bindsnoop.bpf.c 152) ----------------------------------
@@ -203,44 +331,80 @@ class BindEvent(_Base):
     protocol: str = col("", width=5)
     addr: str = col("", template="ipaddr")
     port: int = col(0, template="ipport", dtype=np.int32)
-    interface: str = col("", width=10, hide=True)
+    v6: bool = col(False, width=3, hide=True, dtype=np.bool_)
 
 
-def _decode_bind(self, batch, i):
-    c = batch.cols
-    aux2 = int(c["aux2"][i])
-    return _base_fields(self, batch, i, BindEvent,
-                        protocol="tcp" if aux2 % 2 == 0 else "udp",
-                        addr="0.0.0.0", port=aux2 & 0xFFFF)
+class TraceBind(SourceTraceGadget):
+    native_kind = B.SRC_SOCK_DIAG
+    synth_kind = B.SRC_SYNTH_TCP
+    kind_filter = (EV_BIND,)
+
+    def decode_row(self, batch, i):
+        c = batch.cols
+        if int(c["kind"][i]) == EV_BIND:  # real sock_diag/procfs capture
+            aux2 = int(c["aux2"][i])
+            addrport = self.resolve_key(int(c["aux1"][i]))
+            addr = addrport.rsplit(":", 1)[0] if addrport else ""
+            proto = (aux2 >> 16) & 0xFF
+            return _base_fields(self, batch, i, BindEvent,
+                                protocol="udp" if proto == 17 else "tcp",
+                                addr=addr, port=aux2 & 0xFFFF,
+                                v6=bool((aux2 >> 24) & 1))
+        aux2 = int(c["aux2"][i])
+        return _base_fields(self, batch, i, BindEvent,
+                            protocol="tcp" if aux2 % 2 == 0 else "udp",
+                            addr="0.0.0.0", port=aux2 & 0xFFFF)
 
 
-_simple_gadget("bind", "Trace bind() calls", BindEvent, _decode_bind,
-               B.SRC_SYNTH_TCP)
+_register("bind", "Trace socket binds (sock_diag)", BindEvent, TraceBind)
 
 
 # -- trace/fsslower (ref: fsslower.bpf.c 239) -------------------------------
+
+_FS_OPS = {1: "read", 2: "write", 3: "open", 4: "fsync"}
+
 
 @dataclasses.dataclass
 class FsSlowerEvent(_Base):
     op: str = col("", width=5)
     bytes: int = col(0, width=10, dtype=np.int64)
-    offset: int = col(0, width=10, hide=True, dtype=np.int64)
     latency_us: int = col(0, width=10, dtype=np.int64)
     file: str = col("", width=28, ellipsis="start")
 
 
-def _decode_fsslower(self, batch, i):
-    c = batch.cols
-    ops = ("read", "write", "open", "fsync")
-    return _base_fields(self, batch, i, FsSlowerEvent,
-                        op=ops[int(c["aux2"][i]) % 4],
-                        bytes=int(c["aux1"][i]) & 0xFFFFF,
-                        latency_us=(int(c["aux1"][i]) >> 20) & 0xFFFFF,
-                        file=self.resolve_key(int(c["key_hash"][i])))
+class TraceFsSlower(_PtraceTargetMixin, SourceTraceGadget):
+    native_kind = B.SRC_PTRACE
+    synth_kind = B.SRC_SYNTH_EXEC
+    kind_filter = (EV_FSSLOWER,)
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._target_params()
+        p = ctx.gadget_params
+        self._min_ms = p.get("min-latency").as_int() if "min-latency" in p else 10
+
+    def native_cfg(self) -> str:
+        base = _PtraceTargetMixin.native_cfg(self)
+        return base + f"\x1fmin_lat_us={self._min_ms * 1000}"
+
+    def decode_row(self, batch, i):
+        c = batch.cols
+        if int(c["kind"][i]) == EV_FSSLOWER:  # real ptrace latency
+            aux2 = int(c["aux2"][i])
+            return _base_fields(self, batch, i, FsSlowerEvent,
+                                op=_FS_OPS.get(aux2 >> 32, "?"),
+                                bytes=aux2 & 0xFFFFFFFF,
+                                latency_us=int(c["aux1"][i]),
+                                file=self.resolve_key(int(c["key_hash"][i])))
+        return _base_fields(self, batch, i, FsSlowerEvent,
+                            op=_FS_OPS.get(int(c["aux2"][i]) % 4 + 1, "?"),
+                            bytes=int(c["aux1"][i]) & 0xFFFFF,
+                            latency_us=(int(c["aux1"][i]) >> 20) & 0xFFFFF,
+                            file=self.resolve_key(int(c["key_hash"][i])))
 
 
-_simple_gadget("fsslower", "Trace slow filesystem ops", FsSlowerEvent,
-               _decode_fsslower, B.SRC_SYNTH_EXEC,
-               [ParamDesc(key="min-latency", default="10",
-                          type_hint=TypeHint.INT,
-                          description="min latency (ms) to report")])
+_register("fsslower", "Trace slow filesystem ops (ptrace latency)",
+          FsSlowerEvent, TraceFsSlower,
+          _TARGET_PARAMS + [ParamDesc(key="min-latency", default="10",
+                                      type_hint=TypeHint.INT,
+                                      description="min latency (ms) to report")])

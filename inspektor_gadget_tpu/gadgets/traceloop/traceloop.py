@@ -42,18 +42,35 @@ class SyscallRecord(Event, WithMountNsID):
 
 
 class Traceloop(SourceTraceGadget):
-    """Attacher gadget: one overwritable ring per attached container."""
+    """Attacher gadget: one overwritable ring per attached container.
 
-    native_kind = None
+    Native mode records the REAL syscall stream of a ptrace-traced target
+    (--command/--pid): EV_SYSCALL events whose vocab payload is the decoded
+    "name(args) = ret" line and whose aux2 packs nr/ret — the arg-decode
+    contract of the reference's tracer.go:246-632 tables."""
+
+    native_kind = B.SRC_PTRACE
     synth_kind = B.SRC_SYNTH_EXEC
+    kind_filter = (18,)  # EV_SYSCALL
 
     def __init__(self, ctx):
         super().__init__(ctx)
         p = ctx.gadget_params
         self.ring_size = p.get("ring-size").as_int() if "ring-size" in p else DEFAULT_RING
+        self._command = p.get("command").as_string() if "command" in p else ""
+        self._target_pid = p.get("pid").as_int() if "pid" in p else 0
         self._rings: dict[int, deque] = {}
         self._lock = threading.Lock()
         self._attach_all = True  # without explicit attaches, ring per seen mntns
+
+    def native_ready(self) -> bool:
+        return bool(self._command or self._target_pid)
+
+    def native_cfg(self) -> str:
+        import shlex
+        if self._command:
+            return B.make_cfg(cmd=shlex.split(self._command))
+        return B.make_cfg(pid=self._target_pid)
 
     # Attacher protocol (ref: tracer.go Attach:196/Detach) ------------------
 
@@ -70,6 +87,7 @@ class Traceloop(SourceTraceGadget):
 
     def process_batch(self, batch) -> None:
         c = batch.cols
+        real = self._is_native
         with self._lock:
             for i in range(batch.count):
                 mntns = int(c["mntns"][i])
@@ -78,11 +96,20 @@ class Traceloop(SourceTraceGadget):
                     if not self._attach_all:
                         continue
                     ring = self._rings[mntns] = deque(maxlen=self.ring_size)
-                ring.append((
-                    int(c["ts"][i]), int(c["pid"][i]),
-                    batch.comm_str(i), int(c["aux2"][i]) % 335,
-                    int(c["aux1"][i]),
-                ))
+                aux2 = int(c["aux2"][i])
+                if real:  # EV_SYSCALL: aux2 = nr<<32 | ret, vocab = decoded line
+                    nr = aux2 >> 32
+                    ret = aux2 & 0xFFFFFFFF
+                    if ret >= 0x80000000:
+                        ret -= 1 << 32
+                    line = self.resolve_key(int(c["key_hash"][i]))
+                    ring.append((int(c["ts"][i]), int(c["pid"][i]),
+                                 batch.comm_str(i), nr, line, ret))
+                else:
+                    ring.append((int(c["ts"][i]), int(c["pid"][i]),
+                                 batch.comm_str(i), aux2 % 335,
+                                 f"0x{int(c['aux1'][i]):x}",
+                                 int(c["aux1"][i]) & 0xFF))
 
     # retrospective read (ref: tracer.go Read:246) --------------------------
 
@@ -92,11 +119,10 @@ class Traceloop(SourceTraceGadget):
                      and mntns in self._rings else dict(self._rings))
             out = []
             for ns, ring in rings.items():
-                for ts, pid, comm, nr, aux in ring:
+                for ts, pid, comm, nr, args, ret in ring:
                     out.append(SyscallRecord(
                         timestamp=ts, mountnsid=ns, pid=pid, comm=comm,
-                        syscall=syscall_name(nr),
-                        args=f"0x{aux:x}", ret=int(aux) & 0xFF,
+                        syscall=syscall_name(nr), args=args, ret=ret,
                     ))
         out.sort(key=lambda r: r.timestamp)
         return out
@@ -123,6 +149,10 @@ class TraceloopDesc(GadgetDesc):
         p.append(ParamDesc(key="ring-size", default=str(DEFAULT_RING),
                            type_hint=TypeHint.INT,
                            description="events kept per container"))
+        p.append(ParamDesc(key="command", default="",
+                           description="command to spawn and trace"))
+        p.append(ParamDesc(key="pid", default="0", type_hint=TypeHint.INT,
+                           description="existing pid to attach to"))
         return p
 
     def new_instance(self, ctx) -> Traceloop:

@@ -11,18 +11,30 @@ from .batch import EventBatch, BATCH_COLUMNS
 from .bridge import (
     NativeCapture,
     native_available,
+    make_cfg,
     SRC_SYNTH_EXEC,
     SRC_SYNTH_TCP,
     SRC_SYNTH_DNS,
     SRC_PROC_EXEC,
     SRC_PROC_TCP,
+    SRC_FANOTIFY_EXEC,
+    SRC_FANOTIFY_OPEN,
+    SRC_MOUNTINFO,
+    SRC_SOCK_DIAG,
+    SRC_KMSG_OOM,
+    SRC_PTRACE,
+    SRC_FANOTIFY_RUNC,
+    SRC_PERF_CPU,
 )
 from .synthetic import PySyntheticSource
 
 __all__ = [
     "EventBatch", "BATCH_COLUMNS",
-    "NativeCapture", "native_available",
+    "NativeCapture", "native_available", "make_cfg",
     "SRC_SYNTH_EXEC", "SRC_SYNTH_TCP", "SRC_SYNTH_DNS",
     "SRC_PROC_EXEC", "SRC_PROC_TCP",
+    "SRC_FANOTIFY_EXEC", "SRC_FANOTIFY_OPEN", "SRC_MOUNTINFO",
+    "SRC_SOCK_DIAG", "SRC_KMSG_OOM", "SRC_PTRACE", "SRC_FANOTIFY_RUNC",
+    "SRC_PERF_CPU",
     "PySyntheticSource",
 ]

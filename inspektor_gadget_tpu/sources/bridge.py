@@ -25,9 +25,34 @@ SRC_SYNTH_TCP = 2
 SRC_SYNTH_DNS = 3
 SRC_PROC_EXEC = 100
 SRC_PROC_TCP = 101
+SRC_FANOTIFY_EXEC = 102
+SRC_FANOTIFY_OPEN = 103
+SRC_MOUNTINFO = 104
+SRC_SOCK_DIAG = 105
+SRC_KMSG_OOM = 106
+SRC_PTRACE = 108
+SRC_FANOTIFY_RUNC = 109
+SRC_PERF_CPU = 110
 SRC_PKT_DNS = 200
 SRC_PKT_SNI = 201
 SRC_PKT_FLOW = 202
+
+# kinds that take a "key=value\x1f..." config string (create_cfg path)
+_CFG_KINDS = {SRC_FANOTIFY_OPEN, SRC_MOUNTINFO, SRC_SOCK_DIAG, SRC_KMSG_OOM,
+              SRC_PTRACE, SRC_FANOTIFY_RUNC, SRC_PERF_CPU}
+
+
+def make_cfg(**kw) -> str:
+    """Build the config string for cfg-kind sources. A cmd list is joined
+    with \\x1e (unit separators keep arbitrary argv content safe)."""
+    parts = []
+    for k, v in kw.items():
+        if v is None:
+            continue
+        if isinstance(v, (list, tuple)):
+            v = "\x1e".join(str(x) for x in v)
+        parts.append(f"{k}={v}")
+    return "\x1f".join(parts)
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libigcapture.so"
@@ -57,6 +82,16 @@ def _load():
     p32 = ctypes.POINTER(ctypes.c_uint32)
     lib.ig_source_create.argtypes = [u32, u64, f64, u32, f64, u32]
     lib.ig_source_create.restype = u64
+    lib.ig_source_create_cfg.argtypes = [u32, ctypes.c_char_p, u32]
+    lib.ig_source_create_cfg.restype = u64
+    lib.ig_source_set_filter.argtypes = [u64, p64, i64]
+    lib.ig_source_set_filter.restype = ctypes.c_int
+    lib.ig_source_filtered.argtypes = [u64]
+    lib.ig_source_filtered.restype = u64
+    lib.ig_ptrace_exit_status.argtypes = [u64]
+    lib.ig_ptrace_exit_status.restype = ctypes.c_int
+    lib.ig_perf_supported.argtypes = []
+    lib.ig_perf_supported.restype = ctypes.c_int
     for fn in ("ig_source_start", "ig_source_stop", "ig_source_destroy"):
         getattr(lib, fn).argtypes = [u64]
         getattr(lib, fn).restype = ctypes.c_int
@@ -123,12 +158,17 @@ class NativeCapture:
 
     def __init__(self, kind: int, *, seed: int = 0, rate: float = 0.0,
                  vocab: int = 1000, zipf_s: float = 1.2, ring_pow2: int = 20,
-                 batch_size: int = 8192):
+                 batch_size: int = 8192, cfg: str = ""):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native capture unavailable: {_lib_err}")
         self._lib = lib
-        self._h = lib.ig_source_create(kind, seed, rate, vocab, zipf_s, ring_pow2)
+        if kind in _CFG_KINDS:
+            self._h = lib.ig_source_create_cfg(
+                kind, cfg.encode("utf-8", "replace"), ring_pow2)
+        else:
+            self._h = lib.ig_source_create(kind, seed, rate, vocab, zipf_s,
+                                           ring_pow2)
         if self._h == 0:
             raise ValueError(f"unknown source kind {kind}")
         self.batch_size = batch_size
@@ -198,6 +238,29 @@ class NativeCapture:
 
     def produced(self) -> int:
         return int(self._lib.ig_source_produced(self._h))
+
+    def set_filter(self, mntns_ids) -> None:
+        """Install the capture-side mntns filter (None clears). The filter
+        runs in the C++ capture thread before events reach the ring —
+        the tracer-collection mntnsset-map contract."""
+        if mntns_ids is None:
+            self._lib.ig_source_set_filter(
+                self._h, ctypes.cast(None, ctypes.POINTER(ctypes.c_uint64)), 0)
+            return
+        arr = np.fromiter(mntns_ids, dtype=np.uint64)
+        # an empty-but-present filter blocks everything, matching an empty
+        # mntns map in the reference
+        if arr.size == 0:
+            arr = np.zeros(1, dtype=np.uint64)
+            self._lib.ig_source_set_filter(self._h, _p64(arr), 0)
+            return
+        self._lib.ig_source_set_filter(self._h, _p64(arr), arr.size)
+
+    def filtered(self) -> int:
+        return int(self._lib.ig_source_filtered(self._h))
+
+    def ptrace_exit_status(self) -> int:
+        return int(self._lib.ig_ptrace_exit_status(self._h))
 
     def vocab_lookup(self, key_hash: int) -> str:
         buf = ctypes.create_string_buffer(256)
