@@ -32,6 +32,8 @@ def main(argv=None) -> int:
     sp.add_argument("--kube-api", default="",
                     help="apiserver URL for pod-informer discovery")
     sp.add_argument("--informer-interval", type=float, default=2.0)
+    sp.add_argument("--no-doctor", action="store_true",
+                    help="skip the capture-window probe at startup")
 
     for name in ("liveness", "dump"):
         p = sub.add_parser(name)
@@ -51,6 +53,13 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.cmd == "serve":
+        # entrypoint-analogue environment probe (ref: entrypoint.sh:21-120
+        # detects OS/kernel/runtime before starting the daemon): report
+        # which capture windows work on this host so degraded gadgets are
+        # known up front, not discovered mid-run
+        if not args.no_doctor:
+            from ..doctor import render_report
+            print(render_report(), flush=True)
         from .service import serve
         server, _agent = serve(args.listen, node_name=args.node_name)
         if args.pod_manifest or args.kube_api:

@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="print kubectl deletion manifest list")
     up.set_defaults(func=cmd_undeploy)
 
+    dr = sub.add_parser("doctor", help="probe capture windows, report "
+                        "per-gadget real/degraded/unavailable status")
+    dr.add_argument("-o", "--output", default="table",
+                    choices=["table", "json"])
+    dr.set_defaults(func=cmd_doctor)
+
     bp = sub.add_parser("debug", help="dump agent state (DumpState analogue)")
     bp.add_argument("--remote", default="",
                     help="name=target[,...]; defaults to the local fleet")
@@ -129,6 +135,24 @@ def cmd_list(args) -> int:
     for desc in gadget_registry.get_all():
         print(f"{desc.category:10s} {desc.name:18s} {desc.description}")
     return 0
+
+
+def cmd_doctor(args) -> int:
+    """ref: gadget-container/entrypoint.sh:21-120 environment detection,
+    reshaped as an on-demand capability probe (see doctor.py)."""
+    from ..doctor import gadget_report, probe_windows, render_report
+    windows = probe_windows()
+    gadgets = gadget_report(windows)
+    if args.output == "json":
+        import dataclasses as dc
+        print(json.dumps({
+            "windows": {k: dc.asdict(w) for k, w in windows.items()},
+            "gadgets": [dc.asdict(g) for g in gadgets],
+        }, indent=2))
+    else:
+        print(render_report(windows, gadgets))
+    # exit 1 if any window a registered gadget depends on is down
+    return 1 if any(g.status == "unavailable" for g in gadgets) else 0
 
 
 def cmd_catalog(args) -> int:

@@ -1,0 +1,341 @@
+"""Environment doctor — the entrypoint's capability-probe role.
+
+Reference contract: gadget-container/entrypoint.sh:21-120 detects the OS,
+kernel, container runtime and BPF mount state before starting the daemon,
+and picks the hook installation mechanism accordingly. This build has seven
+heterogeneous capture windows instead of one BPF substrate, so the doctor
+probes each window (fanotify, perf_event_open, /dev/kmsg, ptrace,
+sock_diag, netlink proc-connector, AF_PACKET, mountinfo, procfs) and maps
+every registered gadget to real / degraded / unavailable — run at agent
+start (agent/main.py) and on demand via `ig-tpu doctor`.
+
+Probes are cheap, side-effect-free, and never raise: each returns
+(ok, detail) so a broken window degrades the report, not the process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+
+
+@dataclasses.dataclass
+class Window:
+    name: str
+    ok: bool
+    detail: str
+
+
+# ---------------------------------------------------------------------------
+# Window probes
+# ---------------------------------------------------------------------------
+
+def _probe_native_lib() -> Window:
+    try:
+        from .sources.bridge import native_available
+        if native_available():
+            return Window("native_lib", True, "libigcapture.so loaded")
+        from .sources import bridge
+        return Window("native_lib", False, bridge._lib_err or "build failed")
+    except Exception as e:  # noqa: BLE001
+        return Window("native_lib", False, repr(e))
+
+
+def _probe_fanotify() -> Window:
+    try:
+        from .sources.bridge import _load
+        lib = _load()
+        if lib is None:
+            return Window("fanotify", False, "native lib unavailable")
+        ok = bool(lib.ig_fanotify_supported())
+        return Window("fanotify", ok,
+                      "fanotify_init ok" if ok else
+                      "fanotify_init failed (needs CAP_SYS_ADMIN)")
+    except Exception as e:  # noqa: BLE001
+        return Window("fanotify", False, repr(e))
+
+
+def _probe_perf() -> Window:
+    try:
+        from .sources.bridge import _load
+        lib = _load()
+        if lib is None:
+            return Window("perf", False, "native lib unavailable")
+        ok = bool(lib.ig_perf_supported())
+        if ok:
+            return Window("perf", True, "perf_event_open ok")
+        para = "?"
+        try:
+            para = open("/proc/sys/kernel/perf_event_paranoid").read().strip()
+        except OSError:
+            pass
+        return Window("perf", False,
+                      f"perf_event_open failed (perf_event_paranoid={para})")
+    except Exception as e:  # noqa: BLE001
+        return Window("perf", False, repr(e))
+
+
+def _probe_kmsg() -> Window:
+    try:
+        fd = os.open("/dev/kmsg", os.O_RDONLY | os.O_NONBLOCK)
+        try:
+            try:
+                os.read(fd, 8192)
+            except BlockingIOError:
+                pass  # readable, just no backlog
+        finally:
+            os.close(fd)
+        return Window("kmsg", True, "/dev/kmsg readable")
+    except OSError as e:
+        return Window("kmsg", False, f"/dev/kmsg: {e.strerror}")
+
+
+def _probe_ptrace() -> Window:
+    scope = "?"
+    try:
+        scope = open("/proc/sys/kernel/yama/ptrace_scope").read().strip()
+    except OSError:
+        scope = "absent"
+    if os.geteuid() == 0 and scope != "3":
+        return Window("ptrace", True, f"root, yama scope {scope}")
+    if scope == "0":
+        return Window("ptrace", True, f"yama scope 0 (same-uid attach)")
+    return Window("ptrace", False,
+                  f"euid {os.geteuid()}, yama scope {scope}")
+
+
+def _probe_sock_diag() -> Window:
+    NETLINK_SOCK_DIAG = 4
+    try:
+        s = socket.socket(socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_SOCK_DIAG)
+        s.close()
+        return Window("sock_diag", True, "NETLINK_SOCK_DIAG socket ok")
+    except OSError as e:
+        return Window("sock_diag", False, f"netlink: {e.strerror}")
+
+
+def _probe_netlink_proc() -> Window:
+    # proc connector needs CAP_NET_ADMIN to bind the CN_IDX_PROC group
+    NETLINK_CONNECTOR = 11
+    CN_IDX_PROC = 1
+    try:
+        s = socket.socket(socket.AF_NETLINK, socket.SOCK_DGRAM,
+                          NETLINK_CONNECTOR)
+        try:
+            s.bind((os.getpid() & 0x7FFFFFFF, CN_IDX_PROC))
+        finally:
+            s.close()
+        return Window("netlink_proc", True, "proc connector bind ok")
+    except OSError as e:
+        return Window("netlink_proc", False, f"proc connector: {e.strerror}")
+
+
+def _probe_af_packet() -> Window:
+    try:
+        s = socket.socket(socket.AF_PACKET, socket.SOCK_RAW, 0)
+        s.close()
+        return Window("af_packet", True, "raw packet socket ok")
+    except OSError as e:
+        return Window("af_packet", False,
+                      f"AF_PACKET: {e.strerror} (needs CAP_NET_RAW)")
+
+
+def _probe_mountinfo() -> Window:
+    try:
+        with open("/proc/self/mountinfo") as f:
+            f.readline()
+        return Window("mountinfo", True, "/proc/self/mountinfo readable")
+    except OSError as e:
+        return Window("mountinfo", False, f"mountinfo: {e.strerror}")
+
+
+def _probe_procfs() -> Window:
+    try:
+        os.listdir("/proc")
+        with open("/proc/self/stat"):
+            pass
+        return Window("procfs", True, "/proc readable")
+    except OSError as e:
+        return Window("procfs", False, f"/proc: {e.strerror}")
+
+
+_PROBES = (
+    _probe_native_lib, _probe_fanotify, _probe_perf, _probe_kmsg,
+    _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
+    _probe_mountinfo, _probe_procfs,
+)
+
+
+def probe_windows() -> dict[str, Window]:
+    """Probe every capture window once; returns {name: Window}."""
+    out: dict[str, Window] = {}
+    for probe in _PROBES:
+        w = probe()
+        out[w.name] = w
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-gadget status
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class GadgetStatus:
+    category: str
+    name: str
+    status: str          # real | degraded | unavailable | synthetic-only
+    window: str          # primary window name ("" for synthetic-only)
+    note: str
+
+
+def _source_windows() -> dict[int, tuple[str, str, str]]:
+    """native_kind → (primary window, degraded-fallback window, note)."""
+    from .sources import bridge as B
+    return {
+        B.SRC_PROC_EXEC: ("netlink_proc", "", ""),
+        B.SRC_PROC_TCP: ("procfs", "", ""),
+        B.SRC_FANOTIFY_EXEC: ("fanotify", "", ""),
+        B.SRC_FANOTIFY_OPEN: ("fanotify", "", ""),
+        B.SRC_FANOTIFY_RUNC: ("fanotify", "", ""),
+        B.SRC_MOUNTINFO: ("mountinfo", "", ""),
+        B.SRC_SOCK_DIAG: ("sock_diag", "procfs", "procfs scan fallback"),
+        B.SRC_KMSG_OOM: ("kmsg", "", ""),
+        B.SRC_PTRACE: ("ptrace", "", "needs --command/--pid or container filter"),
+        B.SRC_PERF_CPU: ("perf", "procfs", "procfs stat-delta fallback"),
+        B.SRC_PKT_DNS: ("af_packet", "", ""),
+        B.SRC_PKT_SNI: ("af_packet", "", ""),
+        B.SRC_PKT_FLOW: ("af_packet", "", ""),
+    }
+
+
+# Gadgets that don't route through SourceTraceGadget.native_kind (procfs
+# drain loops, the perf sampler, self-observation) declare their windows
+# here: (primary window, degraded fallback, note).
+_GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
+    ("profile", "cpu"): ("perf", "procfs",
+                         "49Hz callchains; procfs stat-delta fallback"),
+    ("profile", "block-io"): ("procfs", "",
+                              "diskstats windowed latency"),
+    ("top", "file"): ("procfs", "", "/proc/<pid>/io deltas"),
+    ("top", "tcp"): ("procfs", "", "/proc/net drains"),
+    ("top", "block-io"): ("procfs", "", "/proc/diskstats deltas"),
+    ("top", "sketch"): ("native_lib", "", "capture-plane self-observation"),
+    ("snapshot", "process"): ("procfs", "", "procfs collector"),
+    ("snapshot", "socket"): ("procfs", "", "procfs collector"),
+    ("advise", "network-policy"): ("af_packet", "",
+                                   "synthesizes from trace/network events"),
+}
+
+
+def gadget_report(windows: dict[str, Window] | None = None) -> list[GadgetStatus]:
+    """Status of every registered gadget given the probed windows."""
+    from . import all_gadgets  # noqa: F401 — ensure registry is populated
+    from .gadgets import registry as gadget_registry
+
+    if windows is None:
+        windows = probe_windows()
+    native_ok = windows["native_lib"].ok
+    src_map = _source_windows()
+    out: list[GadgetStatus] = []
+
+    for desc in gadget_registry.get_all():
+        # interrogate the gadget class for its native source kind without
+        # instantiating a run: new_instance needs a context, so read the
+        # class attribute off a probe instance when cheap, else the class
+        g_cls = _gadget_class(desc)
+        native_kind = getattr(g_cls, "native_kind", None) if g_cls else None
+        if native_kind is None and (desc.category, desc.name) in _GADGET_WINDOWS:
+            window, fallback, note = _GADGET_WINDOWS[desc.category, desc.name]
+            if windows.get(window) and windows[window].ok:
+                out.append(GadgetStatus(desc.category, desc.name, "real",
+                                        window, note))
+            elif fallback and windows.get(fallback) and windows[fallback].ok:
+                out.append(GadgetStatus(
+                    desc.category, desc.name, "degraded", fallback,
+                    f"{window} unavailable ({windows[window].detail}); {note}"))
+            else:
+                out.append(GadgetStatus(desc.category, desc.name,
+                                        "unavailable", window,
+                                        windows[window].detail))
+            continue
+        if native_kind is None:
+            out.append(GadgetStatus(desc.category, desc.name, "synthetic-only",
+                                    "", "no native window for this gadget"))
+            continue
+        window, fallback, note = src_map.get(native_kind, ("", "", ""))
+        if not native_ok:
+            out.append(GadgetStatus(desc.category, desc.name, "unavailable",
+                                    window, windows["native_lib"].detail))
+            continue
+        if window and windows.get(window) and windows[window].ok:
+            out.append(GadgetStatus(desc.category, desc.name, "real",
+                                    window, note))
+        elif fallback and windows.get(fallback) and windows[fallback].ok:
+            out.append(GadgetStatus(
+                desc.category, desc.name, "degraded", fallback,
+                f"{window} unavailable ({windows[window].detail}); {note}"))
+        else:
+            detail = windows[window].detail if window in windows else "unknown"
+            out.append(GadgetStatus(desc.category, desc.name, "unavailable",
+                                    window, detail))
+    out.sort(key=lambda g: (g.category, g.name))
+    return out
+
+
+def _gadget_class(desc):
+    """Best-effort extraction of the gadget implementation class from a
+    descriptor's new_instance closure (gadget classes carry native_kind as
+    a class attribute; descriptors don't)."""
+    fn = getattr(desc, "new_instance", None)
+    if fn is None:
+        return None
+    func = getattr(fn, "__func__", fn)
+    # _register-built descs close over gadget_cls; hand-written descs
+    # reference the class in code constants or globals
+    closure = getattr(func, "__closure__", None)
+    if closure:
+        for cell in closure:
+            v = cell.cell_contents
+            if isinstance(v, type):
+                return v
+    import inspect
+    try:
+        src_names = func.__code__.co_names
+        module = inspect.getmodule(func)
+        for nm in src_names:
+            v = getattr(module, nm, None)
+            if isinstance(v, type) and hasattr(v, "native_kind"):
+                return v
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+def render_report(windows: dict[str, Window] | None = None,
+                  gadgets: list[GadgetStatus] | None = None) -> str:
+    if windows is None:
+        windows = probe_windows()
+    if gadgets is None:
+        gadgets = gadget_report(windows)
+    lines = ["CAPTURE WINDOWS"]
+    for w in windows.values():
+        mark = "ok " if w.ok else "NO "
+        lines.append(f"  {mark} {w.name:<14s} {w.detail}")
+    lines.append("")
+    lines.append("GADGETS")
+    for g in gadgets:
+        label = f"{g.category}/{g.name}"
+        lines.append(f"  {g.status:<15s} {label:<28s} "
+                     f"{g.window:<13s} {g.note}")
+    counts: dict[str, int] = {}
+    for g in gadgets:
+        counts[g.status] = counts.get(g.status, 0) + 1
+    lines.append("")
+    lines.append("SUMMARY " + "  ".join(
+        f"{k}={v}" for k, v in sorted(counts.items())))
+    return "\n".join(lines)

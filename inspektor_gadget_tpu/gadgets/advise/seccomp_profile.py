@@ -22,7 +22,7 @@ from collections import defaultdict
 from ...params import ParamDesc, ParamDescs
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
-from ..source_gadget import SourceTraceGadget, source_params
+from ..source_gadget import PtraceAttachMixin, SourceTraceGadget, source_params
 from ...sources import bridge as B
 from ...utils.syscalls import syscall_name
 
@@ -47,7 +47,7 @@ def generate_oci_seccomp_profile(syscalls: set[str],
     }
 
 
-class AdviseSeccompProfile(SourceTraceGadget):
+class AdviseSeccompProfile(PtraceAttachMixin, SourceTraceGadget):
     """Native mode records the target's ACTUAL syscall numbers from the
     ptrace stream (EV_SYSCALL aux2 high word = nr), so the generated
     profile is exactly the syscall set the workload exercised — the

@@ -23,7 +23,7 @@ from ...params import ParamDesc, ParamDescs, TypeHint
 from ...types import Event, WithMountNsID
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
-from ..source_gadget import SourceTraceGadget, source_params
+from ..source_gadget import PtraceAttachMixin, SourceTraceGadget, source_params
 from ...sources import bridge as B
 from ...utils.syscalls import syscall_name
 
@@ -40,7 +40,7 @@ class SeccompEvent(Event, WithMountNsID):
     code: str = col("", width=13)
 
 
-class AuditSeccomp(SourceTraceGadget):
+class AuditSeccomp(PtraceAttachMixin, SourceTraceGadget):
     native_kind = B.SRC_PTRACE
     synth_kind = B.SRC_SYNTH_EXEC
     kind_filter = (EV_SYSCALL, EV_SIGNAL)

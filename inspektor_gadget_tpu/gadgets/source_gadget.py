@@ -18,6 +18,7 @@ import numpy as np
 from ..params import ParamDesc, ParamDescs, TypeHint
 from ..sources import EventBatch, PySyntheticSource
 from ..sources.bridge import NativeCapture, native_available
+from ..sources.bridge import make_cfg as B_make_cfg
 from .context import GadgetContext
 from .interface import GadgetDesc
 
@@ -37,6 +38,31 @@ def source_params() -> ParamDescs:
     ])
 
 
+class PtraceAttachMixin:
+    """Attacher implementation for ptrace-window gadgets: a container
+    filter auto-attaches the syscall stream to each matching container's
+    init pid, so capabilities/fsslower/audit-seccomp/traceloop work
+    per-container without an explicit --command/--pid (ref: the
+    reference's per-container attach model, localmanager.go:230-260)."""
+
+    # ptrace-attaching every discovered process would trace the whole
+    # host; the localmanager only attaches when a container selector is set
+    attach_requires_selector = True
+    # the per-container ptrace stream supersedes any system-wide window
+    # (avoids double-reporting, e.g. trace/signal netlink + ptrace)
+    attach_replaces_main = True
+
+    def attach_container(self, container) -> None:
+        key = (getattr(container, "id", "")
+               or str(getattr(container, "pid", 0)))
+        self._attach_ptrace_pid(int(getattr(container, "pid", 0)), key)
+
+    def detach_container(self, container) -> None:
+        key = (getattr(container, "id", "")
+               or str(getattr(container, "pid", 0)))
+        self._detach_key(key)
+
+
 class SourceTraceGadget:
     """Concrete subclasses set: native_kind (proc capture), synth_kind
     (synthetic), decode_row(batch, i) -> event. kind_filter restricts the
@@ -46,6 +72,15 @@ class SourceTraceGadget:
     native_kind: int | None = None
     synth_kind: int = 1
     kind_filter: tuple[int, ...] | None = None
+    # set by the localmanager when an Attacher gadget runs with a container
+    # selector: containers may match later, so the gadget must wait for
+    # attaches instead of failing "no target" at startup
+    attach_pending: bool = False
+    # Attacher gadgets whose attach sources REPLACE the main source (the
+    # per-container ptrace stream supersedes the system-wide window, else
+    # e.g. trace/signal would report each fatal signal twice: once from
+    # netlink exits, once from the ptrace delivery stop)
+    attach_replaces_main: bool = False
 
     def __init__(self, ctx: GadgetContext):
         self.ctx = ctx
@@ -53,6 +88,16 @@ class SourceTraceGadget:
         self._batch_handler: Callable[[EventBatch], None] | None = None
         self._mntns_filter: set[int] | None = None
         self._is_native = False
+        # per-container attached sources (task: Attacher path for ptrace
+        # gadgets — ref localmanager.go:230-260 per-container attach)
+        self._attach_sources: dict[str, NativeCapture] = {}
+        # detached-but-not-yet-freed sources: detach only stop()s (the run
+        # loop may still hold the handle mid-pop); close happens at run
+        # teardown, never concurrently with a pop
+        self._retired_sources: list[NativeCapture] = []
+        import threading
+        self._attach_lock = threading.Lock()
+        self._current_source = None
         p = ctx.gadget_params
         self._mode = p.get("source").as_string() if "source" in p else "auto"
         self._rate = p.get("rate").as_float() if "rate" in p else 100000.0
@@ -92,11 +137,50 @@ class SourceTraceGadget:
         not ready; explicit native mode raises."""
         return self.native_kind is not None
 
+    def has_explicit_target(self) -> bool:
+        """True when the user named a target (--command/--pid) — an
+        explicit target always gets its main source, even when a container
+        selector also attaches per-container streams."""
+        return bool(getattr(self, "_command", "") or
+                    getattr(self, "_target_pid", 0))
+
     def _make_source(self):
         mode = self._mode
+        attach_mode = bool(self._attach_sources) or self.attach_pending
+        # Attach sources replace the main window only when the user did NOT
+        # name an explicit target: `--command X --containername foo` must
+        # still spawn and trace X (the selector adds streams, it never
+        # silently drops the user's target).
+        if mode in ("auto", "native") and attach_mode and (
+                not self.native_ready()
+                or (self.attach_replaces_main
+                    and not self.has_explicit_target())):
+            if not native_available():
+                raise RuntimeError(
+                    f"{type(self).__name__}: container auto-attach needs "
+                    "the native capture library, which is unavailable")
+            # per-container attached sources carry (or will carry, once a
+            # container matches the selector) the capture; no main source
+            if not self._attach_sources:
+                self.ctx.logger.info(
+                    "%s: no container matches the selector yet; waiting "
+                    "for attach", type(self).__name__)
+            self._threaded = True
+            self._is_native = True
+            return None
         if mode == "auto":
             if self.native_ready() and native_available():
                 mode = "native"
+            elif self.native_kind is not None and native_available():
+                # A real window exists but can't run without a target:
+                # fail loudly rather than silently emitting fabricated
+                # rows (a user running `trace capabilities` system-wide
+                # must never get synthetic data labeled as real).
+                raise RuntimeError(
+                    f"{type(self).__name__}: the native capture window "
+                    "needs a target — pass --command/--pid, or set a "
+                    "container filter to auto-attach; use "
+                    "--source synthetic explicitly for a demo stream")
             elif native_available():
                 mode = "synthetic"
             else:
@@ -108,7 +192,7 @@ class SourceTraceGadget:
             if not self.native_ready():
                 raise RuntimeError(
                     f"{type(self).__name__}: native source needs a target "
-                    "(--command/--pid)")
+                    "(--command/--pid or a container filter to auto-attach)")
             src = NativeCapture(self.native_kind, ring_pow2=20,
                                 batch_size=self._batch_size,
                                 cfg=self.native_cfg())
@@ -133,6 +217,46 @@ class SourceTraceGadget:
                                  vocab=self._vocab, zipf_s=self._zipf,
                                  batch_size=self._batch_size)
 
+    # per-container attach (ref: localmanager.go:230-260 Attacher path) -----
+
+    def _attach_ptrace_pid(self, pid: int, key: str) -> None:
+        """Attach a ptrace capture to an existing pid (a container's init
+        process); the run loop pops it alongside the main source."""
+        from ..sources.bridge import SRC_PTRACE
+        if pid <= 0:
+            raise ValueError(f"attach needs a live pid, got {pid}")
+        src = NativeCapture(SRC_PTRACE, ring_pow2=18,
+                            batch_size=self._batch_size,
+                            cfg=B_make_cfg(pid=pid))
+        src.start()
+        with self._attach_lock:
+            old = self._attach_sources.get(key)
+            self._attach_sources[key] = src
+        if old is not None:  # re-attach for the same key: retire the old one
+            self._retire(old)
+
+    def _retire(self, src) -> None:
+        """Stop a source but defer freeing: the run loop may hold its handle
+        mid-pop (freeing here would be a native use-after-free); the handle
+        stays valid until run teardown / GC closes it."""
+        try:
+            src.stop()
+        except Exception:
+            pass
+        with self._attach_lock:
+            self._retired_sources.append(src)
+
+    def _detach_key(self, key: str) -> None:
+        with self._attach_lock:
+            src = self._attach_sources.pop(key, None)
+        if src is not None:
+            self._retire(src)
+
+    def _active_sources(self) -> list:
+        with self._attach_lock:
+            extras = list(self._attach_sources.values())
+        return ([self.source] if self.source is not None else []) + extras
+
     # run loop --------------------------------------------------------------
 
     def run(self, ctx: GadgetContext) -> None:
@@ -140,37 +264,51 @@ class SourceTraceGadget:
         deadline_hit = False
         try:
             while not ctx.done and not deadline_hit:
-                batch = self.source.pop()
-                if batch.count == 0:
+                got = 0
+                for src in self._active_sources():
+                    self._current_source = src
+                    batch = src.pop()
+                    if batch.count == 0:
+                        continue
+                    got += batch.count
+                    self._apply_kind_filter(batch)
+                    self._apply_filter(batch)
+                    if batch.count:
+                        self.process_batch(batch)
+                    if batch.count and self._batch_handler is not None:
+                        self._batch_handler(batch)
+                    if batch.count and self._event_handler is not None:
+                        for i in range(batch.count):
+                            self._event_handler(self.decode_row(batch, i))
+                if got == 0:
                     if self._source_done():
                         break  # e.g. traced command exited, ring drained
                     if ctx.sleep_or_done(0.01):
                         break
                     continue
-                self._apply_kind_filter(batch)
-                self._apply_filter(batch)
-                if batch.count:
-                    self.process_batch(batch)
-                if batch.count and self._batch_handler is not None:
-                    self._batch_handler(batch)
-                if batch.count and self._event_handler is not None:
-                    for i in range(batch.count):
-                        self._event_handler(self.decode_row(batch, i))
                 if not self._threaded:
                     # pysynthetic generates instantly; pace by rate
-                    if ctx.sleep_or_done(batch.count / max(self._rate, 1.0)):
+                    if ctx.sleep_or_done(got / max(self._rate, 1.0)):
                         break
         finally:
-            try:
-                self.source.stop()
-                self.source.close()
-            except Exception:
-                pass
+            with self._attach_lock:
+                retired = self._retired_sources
+                self._retired_sources = []
+            for src in self._active_sources() + retired:
+                try:
+                    src.stop()
+                    src.close()
+                except Exception:
+                    pass
 
     def _source_done(self) -> bool:
-        """True when the source will never produce again (a ptrace-spawned
-        command has exited and its ring is drained)."""
+        """True when no source will ever produce again (a ptrace-spawned
+        command has exited and its ring is drained). Attach-mode gadgets
+        keep running: new containers may appear at any time."""
         from ..sources.bridge import SRC_PTRACE
+        with self._attach_lock:
+            if self._attach_sources:
+                return False
         src = self.source
         if (self._is_native and isinstance(src, NativeCapture)
                 and src.kind == SRC_PTRACE):
@@ -218,6 +356,17 @@ class SourceTraceGadget:
         raise NotImplementedError
 
     def resolve_key(self, key_hash: int) -> str:
-        if self.source is None:
-            return ""
-        return self.source.vocab_lookup(key_hash)
+        # prefer the source that produced the batch being decoded; fall
+        # back to the others (each capture keeps its own vocab side-table)
+        cur = self._current_source
+        if cur is not None:
+            s = cur.vocab_lookup(key_hash)
+            if s:
+                return s
+        for src in self._active_sources():
+            if src is cur:
+                continue
+            s = src.vocab_lookup(key_hash)
+            if s:
+                return s
+        return ""

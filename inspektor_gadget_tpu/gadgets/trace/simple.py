@@ -34,7 +34,7 @@ from ...params import ParamDesc, ParamDescs, TypeHint
 from ...types import Event, WithMountNsID
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
-from ..source_gadget import SourceTraceGadget, source_params
+from ..source_gadget import PtraceAttachMixin, SourceTraceGadget, source_params
 from ...sources import bridge as B
 
 # EventKind values (native/events.h)
@@ -58,9 +58,10 @@ def _base_fields(g, batch, i, cls, **kw):
     )
 
 
-class _PtraceTargetMixin:
-    """Gadgets whose native window is the ptrace stream need a target
-    (matching the reference's traceloop per-container attach model)."""
+class _PtraceTargetMixin(PtraceAttachMixin):
+    """Gadgets whose native window is the ptrace stream need a target:
+    an explicit --command/--pid, or a container filter whose matches are
+    auto-attached via the Attacher path (PtraceAttachMixin)."""
 
     def _target_params(self):
         p = self.ctx.gadget_params
@@ -215,7 +216,10 @@ class TraceSignal(_PtraceTargetMixin, SourceTraceGadget):
     def __init__(self, ctx):
         super().__init__(ctx)
         self._target_params()
-        if self.native_ready():
+        # only a given --command/--pid selects the ptrace window (the
+        # mixin's readiness check); self.native_ready() would recurse into
+        # the always-True override below
+        if _PtraceTargetMixin.native_ready(self):
             self.native_kind = B.SRC_PTRACE
 
     # netlink mode needs no target; ptrace mode requires one

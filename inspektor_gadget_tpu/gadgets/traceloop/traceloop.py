@@ -24,7 +24,7 @@ from ...params import ParamDesc, ParamDescs, TypeHint
 from ...types import Event, WithMountNsID
 from ..interface import Attacher, GadgetDesc, GadgetType
 from ..registry import register
-from ..source_gadget import SourceTraceGadget, source_params
+from ..source_gadget import PtraceAttachMixin, SourceTraceGadget, source_params
 from ...sources import bridge as B
 from ...utils.syscalls import syscall_name
 
@@ -52,6 +52,9 @@ class Traceloop(SourceTraceGadget):
     native_kind = B.SRC_PTRACE
     synth_kind = B.SRC_SYNTH_EXEC
     kind_filter = (18,)  # EV_SYSCALL
+    # attach now ptrace-attaches (not just ring creation): gate on selector
+    attach_requires_selector = True
+    attach_replaces_main = True
 
     def __init__(self, ctx):
         super().__init__(ctx)
@@ -78,10 +81,20 @@ class Traceloop(SourceTraceGadget):
         with self._lock:
             self._rings.setdefault(container.mntns, deque(maxlen=self.ring_size))
             self._attach_all = False
+        # also attach the real syscall stream to the container's init pid
+        # so the ring records genuine history, not just whatever the main
+        # source (if any) happens to carry
+        try:
+            PtraceAttachMixin.attach_container(self, container)
+        except Exception as e:  # noqa: BLE001 — attach best-effort
+            self.ctx.logger.warning(
+                "traceloop ptrace attach %s: %s",
+                getattr(container, "name", "?"), e)
 
     def detach_container(self, container) -> None:
         with self._lock:
             self._rings.pop(container.mntns, None)
+        PtraceAttachMixin.detach_container(self, container)
 
     # capture ---------------------------------------------------------------
 

@@ -100,7 +100,12 @@ class LocalManagerInstance(OperatorInstance):
             if self.selector.name or self.selector.pod or self.selector.namespace:
                 self.gadget.set_mntns_filter(
                     op.tc.tracer_mntns_set(self._tracer_id))
-        if isinstance(self.gadget, Attacher):
+        if isinstance(self.gadget, Attacher) and self._attach_enabled():
+            # tell the gadget attaches are coming (possibly later — the
+            # selector may match a container that doesn't exist yet), so it
+            # must wait rather than fail "no target" at startup
+            if hasattr(type(self.gadget), "attach_pending"):
+                self.gadget.attach_pending = True
             for c in op.cc.get_all(self.selector):
                 try:
                     self.gadget.attach_container(c)
@@ -123,6 +128,21 @@ class LocalManagerInstance(OperatorInstance):
                     pass
             self._attached.clear()
 
+    def _attach_enabled(self) -> bool:
+        """Heavy per-container attaches (the ptrace stream) only run when
+        the user scoped the gadget with a container selector — attaching to
+        every procfs-discovered process would ptrace the whole host. Light
+        attachers (traceloop rings, netns sockets) opt out of the gate via
+        attach_requires_selector=False."""
+        # an explicitly synthetic run must never interleave real capture
+        # rows (they'd hit the synthetic decode branch as garbage)
+        if getattr(self.gadget, "_mode", "auto") not in ("auto", "native"):
+            return False
+        if not getattr(self.gadget, "attach_requires_selector", False):
+            return True
+        return bool(self.selector.name or self.selector.pod
+                    or self.selector.namespace)
+
     def _on_container_event(self, ev) -> None:
         if not self.selector.matches(ev.container):
             return
@@ -132,7 +152,7 @@ class LocalManagerInstance(OperatorInstance):
                     self.op.tc.tracer_mntns_set(self._tracer_id))
             except KeyError:
                 pass
-        if isinstance(self.gadget, Attacher):
+        if isinstance(self.gadget, Attacher) and self._attach_enabled():
             if ev.type == EventType.ADD:
                 try:
                     self.gadget.attach_container(ev.container)

@@ -192,8 +192,18 @@ class NativeCapture:
         return self
 
     def __exit__(self, *exc):
+        # Stop capture (joins the thread, releases fds) but keep the native
+        # handle alive: the vocab side-table must stay resolvable after the
+        # window closes so labels (paths, syscall lines, comms) can still be
+        # looked up from drained rows. The handle is freed on explicit
+        # close() or GC.
         self.stop()
-        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def pop(self) -> EventBatch:
         """Pop up to batch_size events; reuses one internal buffer set."""

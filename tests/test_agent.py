@@ -60,11 +60,11 @@ def test_single_node_stream_with_seq(agents):
     assert rows[0][1]["comm"].startswith("proc-")
     # loss accounting contract (not zero-loss: under CPU contention the
     # server's bounded buffer may drop, as the reference's does —
-    # service.go:160-167): any seq gap must be matched by reported drops
-    if res["gaps"]:
-        assert res["dropped"] > 0, "seq gaps without drop accounting"
-    else:
-        assert res["dropped"] == 0
+    # service.go:160-167): every client-observed seq gap must be covered by
+    # the server's drop count. Drops past the last delivered message (tail
+    # eviction while the run winds down) legitimately show no gap, so
+    # dropped > 0 with gaps == 0 is valid — the reverse is not.
+    assert res["gaps"] <= res["dropped"], "seq gaps exceed drop accounting"
     client.close()
 
 

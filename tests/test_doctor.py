@@ -1,0 +1,292 @@
+"""Environment doctor + fail-loudly + container auto-attach tests.
+
+Models the entrypoint's capability detection
+(reference gadget-container/entrypoint.sh:21-120) and the per-container
+attach model (localmanager.go:230-260): probes must describe this host,
+no-target ptrace gadgets must error rather than fabricate, and a container
+filter must auto-attach the syscall stream.
+"""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.doctor import (
+    gadget_report, probe_windows, render_report,
+)
+from inspektor_gadget_tpu.gadgets import GadgetContext, get
+from inspektor_gadget_tpu.runtime import LocalRuntime
+from inspektor_gadget_tpu.sources import native_available
+
+needs_native = pytest.mark.skipif(not native_available(), reason="no native lib")
+needs_root = pytest.mark.skipif(os.geteuid() != 0, reason="needs root")
+
+
+def test_probe_windows_names_and_shape():
+    windows = probe_windows()
+    expected = {"native_lib", "fanotify", "perf", "kmsg", "ptrace",
+                "sock_diag", "netlink_proc", "af_packet", "mountinfo",
+                "procfs"}
+    assert set(windows) == expected
+    for w in windows.values():
+        assert isinstance(w.ok, bool) and w.detail
+
+
+def test_gadget_report_covers_every_registered_gadget():
+    from inspektor_gadget_tpu.gadgets import get_all
+    report = gadget_report()
+    reported = {(g.category, g.name) for g in report}
+    registered = {(d.category, d.name) for d in get_all()}
+    assert reported == registered
+    assert all(g.status in ("real", "degraded", "unavailable",
+                            "synthetic-only") for g in report)
+
+
+@needs_native
+def test_gadget_report_reflects_live_windows():
+    """On a host where the windows probe ok, the trace family maps real."""
+    windows = probe_windows()
+    by_name = {(g.category, g.name): g for g in gadget_report(windows)}
+    if windows["fanotify"].ok:
+        assert by_name[("trace", "open")].status == "real"
+    if windows["mountinfo"].ok:
+        assert by_name[("trace", "mount")].status == "real"
+    if windows["ptrace"].ok:
+        assert by_name[("trace", "capabilities")].status == "real"
+    # a window reported down must degrade/unavail its gadget, never "real"
+    down = dict(windows)
+    import dataclasses
+    down["fanotify"] = dataclasses.replace(windows["fanotify"], ok=False,
+                                           detail="forced down")
+    g = {(x.category, x.name): x for x in gadget_report(down)}
+    assert g[("trace", "open")].status == "unavailable"
+
+
+def test_render_report_has_sections():
+    out = render_report()
+    assert "CAPTURE WINDOWS" in out and "GADGETS" in out and "SUMMARY" in out
+
+
+def test_doctor_cli_command():
+    from inspektor_gadget_tpu.cli.main import main
+    # table output; exit code 0 when nothing is unavailable on this host
+    rc = main(["doctor"])
+    assert rc in (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# fail-loudly: a no-target ptrace gadget must error, never fabricate
+# ---------------------------------------------------------------------------
+
+@needs_native
+@pytest.mark.parametrize("category,name", [
+    ("trace", "capabilities"), ("trace", "fsslower"), ("audit", "seccomp"),
+])
+def test_no_target_ptrace_gadget_fails_loudly(category, name):
+    desc = get(category, name)
+    params = desc.params().to_params()  # source defaults to auto, no target
+    ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
+    events = []
+    result = LocalRuntime().run_gadget(ctx, on_event=events.append)
+    errs = result.errors()
+    assert errs, "no-target ptrace gadget ran without erroring"
+    assert "target" in str(errs).lower()
+    assert not events, "fabricated events emitted despite the error"
+
+
+@needs_native
+def test_explicit_synthetic_still_works():
+    desc = get("trace", "capabilities")
+    params = desc.params().to_params()
+    params.set("source", "synthetic")
+    params.set("rate", "50000")
+    # the threaded source ramps up over ~0.5s; give it a whole second
+    ctx = GadgetContext(desc, gadget_params=params, timeout=1.0)
+    events = []
+    result = LocalRuntime().run_gadget(ctx, on_event=events.append)
+    assert not result.errors()
+    assert events
+
+
+# ---------------------------------------------------------------------------
+# container auto-attach: the Attacher path carries the capture
+# ---------------------------------------------------------------------------
+
+class _FakeContainer:
+    def __init__(self, pid, id="c1", name="probe", mntns=0):
+        self.pid = pid
+        self.id = id
+        self.name = name
+        self.mntns = mntns
+
+
+@needs_native
+@needs_root
+def test_ptrace_gadget_auto_attach_captures_container_activity():
+    """Attach trace/capabilities to a fake container's init pid and observe
+    a real CAP_CHOWN from inside it — no --command/--pid given."""
+    open("/tmp/ig_attach_probe", "w").write("x")
+    child = subprocess.Popen(
+        ["sh", "-c",
+         "sleep 0.8; chown 0:0 /tmp/ig_attach_probe; sleep 4"])
+    try:
+        desc = get("trace", "capabilities")
+        params = desc.params().to_params()
+        ctx = GadgetContext(desc, gadget_params=params, timeout=3.0)
+        g = desc.new_instance(ctx)
+        g.attach_container(_FakeContainer(pid=child.pid))
+        events = []
+        g.set_event_handler(events.append)
+        import threading
+        th = threading.Thread(target=g.run, args=(ctx,))
+        th.start()
+        deadline = time.time() + 4.0
+        while time.time() < deadline:
+            if any(e.cap == "CHOWN" for e in events if e is not None):
+                break
+            time.sleep(0.1)
+        ctx.cancel()
+        th.join(3.0)
+    finally:
+        child.kill()
+        child.wait()
+    assert any(e.cap == "CHOWN" and e.verdict == "allow"
+               for e in events if e is not None), \
+        [getattr(e, "cap", e) for e in events][:20]
+
+
+@needs_native
+@needs_root
+def test_container_filter_auto_attach_through_runtime():
+    """Full stack: a containername selector on the localmanager operator
+    auto-attaches trace/capabilities to the matching container's pid —
+    the reference's per-container attach semantics without --pid."""
+    from inspektor_gadget_tpu.containers import Container
+    from inspektor_gadget_tpu.operators.operators import ensure_initialized
+    from inspektor_gadget_tpu.params import Collection
+
+    open("/tmp/ig_attach_probe2", "w").write("x")
+    child = subprocess.Popen(
+        ["sh", "-c",
+         "sleep 1.0; chown 0:0 /tmp/ig_attach_probe2; sleep 4"])
+    lm = ensure_initialized("localmanager")
+    cid = "igtest-attach"
+    try:
+        lm.cc.add_container(Container(
+            id=cid, name="ig-attach-probe", pid=child.pid,
+            mntns=os.stat(f"/proc/{child.pid}/ns/mnt").st_ino))
+        desc = get("trace", "capabilities")
+        params = desc.params().to_params()
+        op_params = Collection()
+        lp = lm.instance_params().to_params()
+        lp.set("containername", "ig-attach-probe")
+        op_params["operator.localmanager."] = lp
+        ctx = GadgetContext(desc, gadget_params=params,
+                            operator_params=op_params, timeout=4.0)
+        events = []
+        result = LocalRuntime().run_gadget(ctx, on_event=events.append)
+        assert not result.errors(), result.errors()
+    finally:
+        lm.cc.remove_container(cid)
+        child.kill()
+        child.wait()
+    assert any(e is not None and e.cap == "CHOWN" for e in events), \
+        [getattr(e, "cap", e) for e in events][:20]
+
+
+@needs_native
+@needs_root
+def test_no_selector_means_no_auto_attach():
+    """Without a container selector the Attacher gate stays closed: the
+    gadget must error loudly, not ptrace every discovered process."""
+    desc = get("trace", "capabilities")
+    params = desc.params().to_params()
+    ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
+    result = LocalRuntime().run_gadget(ctx)
+    assert result.errors()
+
+
+@needs_native
+@needs_root
+def test_attach_then_detach_stops_capture():
+    child = subprocess.Popen(["sleep", "5"])
+    try:
+        desc = get("trace", "capabilities")
+        params = desc.params().to_params()
+        ctx = GadgetContext(desc, gadget_params=params, timeout=1.0)
+        g = desc.new_instance(ctx)
+        c = _FakeContainer(pid=child.pid)
+        g.attach_container(c)
+        assert g._attach_sources
+        g.detach_container(c)
+        assert not g._attach_sources
+        # detach retires (stops) but must NOT free: a concurrent pop may
+        # still hold the handle — the retired source stays valid
+        assert g._retired_sources
+        assert g._retired_sources[0].pop().count >= 0  # handle still live
+    finally:
+        child.kill()
+        child.wait()
+
+
+@needs_native
+@needs_root
+def test_selector_with_late_container_waits_then_attaches():
+    """A selector that matches nothing at startup must not error: the
+    gadget waits, and a container added mid-run attaches live."""
+    from inspektor_gadget_tpu.containers import Container
+    from inspektor_gadget_tpu.operators.operators import ensure_initialized
+    from inspektor_gadget_tpu.params import Collection
+    import threading
+
+    open("/tmp/ig_attach_probe3", "w").write("x")
+    lm = ensure_initialized("localmanager")
+    cid = "igtest-late"
+    desc = get("trace", "capabilities")
+    params = desc.params().to_params()
+    op_params = Collection()
+    lp = lm.instance_params().to_params()
+    lp.set("containername", "ig-late-probe")
+    op_params["operator.localmanager."] = lp
+    ctx = GadgetContext(desc, gadget_params=params,
+                        operator_params=op_params, timeout=5.0)
+    events = []
+    box = {}
+
+    def _run():
+        box["result"] = LocalRuntime().run_gadget(ctx, on_event=events.append)
+
+    th = threading.Thread(target=_run)
+    th.start()
+    child = None
+    try:
+        time.sleep(1.0)  # gadget is up, selector matches nothing yet
+        assert th.is_alive(), "gadget exited instead of waiting for attach"
+        child = subprocess.Popen(
+            ["sh", "-c",
+             "sleep 0.5; chown 0:0 /tmp/ig_attach_probe3; sleep 4"])
+        lm.cc.add_container(Container(
+            id=cid, name="ig-late-probe", pid=child.pid,
+            mntns=os.stat(f"/proc/{child.pid}/ns/mnt").st_ino))
+        deadline = time.time() + 4.0
+        while time.time() < deadline:
+            if any(e is not None and e.cap == "CHOWN" for e in events):
+                break
+            time.sleep(0.1)
+        # mid-run detach while the run loop is popping: must not crash
+        lm.cc.remove_container(cid)
+        time.sleep(0.3)
+        ctx.cancel()
+        th.join(4.0)
+    finally:
+        lm.cc.remove_container(cid)
+        if child is not None:
+            child.kill()
+            child.wait()
+    result = box.get("result")
+    assert result is not None and not result.errors(), (
+        result.errors() if result else "no result")
+    assert any(e is not None and e.cap == "CHOWN" for e in events)

@@ -183,7 +183,10 @@ def test_ptrace_decodes_real_syscalls():
     lines = [src.vocab_lookup(kh) for _, kh, *_ in rows]
     execves = [l for l in lines if l.startswith("execve(")]
     opens = [l for l in lines if "/etc/hostname" in l]
-    assert any('"/bin/sh"' in l or '"sh"' in l for l in execves)
+    # sh's resolved path varies by host ($PATH walk: /bin/sh, /usr/bin/sh…)
+    # — assert a successful execve of *some* sh, not a fixed location
+    assert any(('/sh"' in l or '"sh"' in l) and l.endswith("= 0")
+               for l in execves), execves
     assert any(l.startswith("openat(") and l.endswith("= 3") for l in opens), opens
     # nr/ret packed in aux2: every execve that succeeded has ret 0
     exec_rows = [r for r in rows if src.vocab_lookup(r[1]).startswith("execve(")
@@ -266,7 +269,12 @@ def _run_gadget(category, name, flags, trigger=None, timeout=4.0):
 @needs_root
 def test_trace_open_gadget_real_end_to_end():
     def trigger():
-        subprocess.run(["sh", "-c", "date > /tmp/ig_g_open"], check=True)
+        # repeat the open until the run window closes: under load the
+        # capture source may start after the first write, and fanotify
+        # only reports opens that happen while the mark is live
+        for _ in range(8):
+            subprocess.run(["sh", "-c", "date > /tmp/ig_g_open"], check=True)
+            time.sleep(0.3)
     _, events = _run_gadget("trace", "open", {"source": "native",
                                               "paths": "/tmp"},
                             trigger, timeout=3.0)
@@ -367,7 +375,10 @@ def test_audit_seccomp_sees_real_denial():
     # denies with EPERM — exactly the ERRNO outcome audit/seccomp reports.
     open("/tmp/ig_audit_probe", "w").write("x")
     os.chown("/tmp/ig_audit_probe", 0, 0)
-    cmd = ("python -c \"import os; os.setuid(1); "
+    # -S skips site processing: this image's sitecustomize boots a TPU
+    # backend at interpreter start, which is slow under load (and hangs
+    # outright when the device tunnel is down) — the probe only needs os
+    cmd = ("python -S -c \"import os; os.setuid(1); "
            "os.chown('/tmp/ig_audit_probe', 1, 1)\"")
     _, events = _run_gadget("audit", "seccomp",
                             {"source": "native", "command": cmd},
@@ -384,7 +395,7 @@ def test_profile_cpu_perf_sampler_real_samples():
     from inspektor_gadget_tpu.gadgets import GadgetContext, get
     import threading
     spin = subprocess.Popen(
-        ["python", "-c",
+        ["python", "-S", "-c",
          "import time,sys\nt=time.time()\nwhile time.time()-t<6: pass"])
     try:
         desc = get("profile", "cpu")
