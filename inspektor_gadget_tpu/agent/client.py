@@ -25,13 +25,20 @@ CATALOG_CACHE = Path.home() / ".ig-tpu" / "catalog.json"
 
 
 class AgentClient:
-    def __init__(self, target: str, node_name: str = ""):
+    def __init__(self, target: str, node_name: str = "", dialer=None):
+        """dialer: how to reach the agent (default DirectDialer). An
+        ExecTunnelDialer reaches agents with no routable address by
+        tunneling over a subprocess's stdio — the reference's
+        k8s-exec-dialer contract (k8s-exec-dialer.go:1-132)."""
+        from .dialer import DirectDialer
         self.target = target
         self.node_name = node_name or target
-        self.channel = grpc.insecure_channel(target)
+        self.dialer = dialer or DirectDialer()
+        self.channel = self.dialer.dial(target)
 
     def close(self) -> None:
         self.channel.close()
+        self.dialer.close()
 
     # -- catalog ------------------------------------------------------------
 

@@ -60,12 +60,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="print DaemonSet+RBAC manifests")
     dp.add_argument("--local", type=int, default=0,
                     help="start N local agent daemons")
+    dp.add_argument("--apply", action="store_true",
+                    help="apply manifests via kubectl and wait for rollout")
+    dp.add_argument("--context", default="", help="kubectl context for --apply")
+    dp.add_argument("--rollout-timeout", type=float, default=120.0)
     dp.add_argument("--image", default="")
     dp.set_defaults(func=cmd_deploy)
 
     up = sub.add_parser("undeploy", help="stop local agents / render deletion")
     up.add_argument("--render", action="store_true",
                     help="print kubectl deletion manifest list")
+    up.add_argument("--apply", action="store_true",
+                    help="delete the deployed manifests via kubectl")
+    up.add_argument("--context", default="", help="kubectl context for --apply")
     up.set_defaults(func=cmd_undeploy)
 
     dr = sub.add_parser("doctor", help="probe capture windows, report "
@@ -171,6 +178,19 @@ def cmd_deploy(args) -> int:
     if args.render:
         print(render_manifests(image=args.image or AGENT_IMAGE))
         return 0
+    if args.apply:
+        # ref: deploy.go:100-546 — apply + wait for DaemonSet rollout
+        from .apply import KubectlApplier, deploy as apply_deploy
+        try:
+            desired, ready = apply_deploy(
+                KubectlApplier(context=args.context),
+                render_manifests(image=args.image or AGENT_IMAGE),
+                rollout_timeout=args.rollout_timeout)
+        except (RuntimeError, TimeoutError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(f"deployed: {ready}/{desired} agents ready")
+        return 0
     if args.local > 0:
         try:
             targets = deploy_local(args.local)
@@ -201,6 +221,17 @@ def cmd_undeploy(args) -> int:
     from .deploy import render_undeploy, undeploy_local
     if args.render:
         print(render_undeploy())
+        return 0
+    if args.apply:
+        from .apply import KubectlApplier, undeploy as apply_undeploy
+        from .deploy import render_manifests
+        try:
+            removed = apply_undeploy(KubectlApplier(context=args.context),
+                                     render_manifests())
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print("removed: " + ", ".join(f"{k}/{n}" for k, n in removed))
         return 0
     stopped = undeploy_local()
     print(f"stopped {len(stopped)} agents" + (f": {', '.join(stopped)}"

@@ -221,6 +221,7 @@ _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
     ("top", "tcp"): ("procfs", "", "/proc/net drains"),
     ("top", "block-io"): ("procfs", "", "/proc/diskstats deltas"),
     ("top", "sketch"): ("native_lib", "", "capture-plane self-observation"),
+    ("top", "self"): ("native_lib", "", "native source self-stats"),
     ("snapshot", "process"): ("procfs", "", "procfs collector"),
     ("snapshot", "socket"): ("procfs", "", "procfs collector"),
     ("advise", "network-policy"): ("af_packet", "",

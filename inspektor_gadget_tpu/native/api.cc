@@ -111,6 +111,7 @@ uint64_t ig_source_create(uint32_t kind, uint64_t seed, double rate,
     default:
       return 0;
   }
+  s->set_kind(kind);
   std::lock_guard<std::mutex> g(g_mu);
   uint64_t id = g_next_id++;
   g_sources[id] = s;
@@ -154,10 +155,43 @@ uint64_t ig_source_create_cfg(uint32_t kind, const char* cfg,
   (void)cap;
   return 0;
 #endif
+  s->set_kind(kind);
   std::lock_guard<std::mutex> g(g_mu);
   uint64_t id = g_next_id++;
   g_sources[id] = s;
   return id;
+}
+
+// Enumerate all live sources with self-stats — the top/ebpf contract
+// (reference pkg/gadgets/top/ebpf/tracer.go:55-418 iterates every loaded
+// BPF program with runtime/run-count from kernel stats; here every live
+// capture source reports thread CPU time, ring occupancy and loss
+// counters). Any output pointer may be null. Returns entries written.
+int64_t ig_sources_stats(uint64_t* ids, uint32_t* kinds, uint64_t* produced,
+                         uint64_t* consumed, uint64_t* drops,
+                         uint64_t* filtered, uint64_t* ring_len,
+                         uint64_t* ring_cap, uint64_t* cpu_ns, int64_t cap) {
+  if (cap <= 0) return -1;
+  std::lock_guard<std::mutex> g(g_mu);  // also blocks concurrent destroy
+  int64_t n = 0;
+  for (auto& kv : g_sources) {
+    if (n >= cap) break;
+    Source* s = kv.second;
+    if (ids) ids[n] = kv.first;
+    if (kinds) kinds[n] = s->kind();
+    if (produced) produced[n] = s->produced();
+    // the ring's own tail counter — deriving it as produced-ring_len from
+    // two separate loads can underflow when the producer advances between
+    // the reads
+    if (consumed) consumed[n] = s->consumed();
+    if (drops) drops[n] = s->drops();
+    if (filtered) filtered[n] = s->filtered();
+    if (ring_len) ring_len[n] = s->ring_len();
+    if (ring_cap) ring_cap[n] = s->ring_capacity();
+    if (cpu_ns) cpu_ns[n] = s->thread_cpu_ns();
+    n++;
+  }
+  return n;
 }
 
 // Capture-side container filter (ref: tracer-collection.go:100-134 mntns

@@ -63,6 +63,7 @@ class RingBuffer {
     return static_cast<size_t>(head_.load(std::memory_order_acquire) -
                                tail_.load(std::memory_order_acquire));
   }
+  size_t capacity() const { return cap_; }
 
  private:
   const size_t cap_;

@@ -15,6 +15,7 @@
 // Every source owns an SPSC ring; a drop is counted, never blocks capture.
 
 #include <fcntl.h>
+#include <pthread.h>
 #include <unistd.h>
 
 #include <cerrno>
@@ -99,12 +100,29 @@ class Source {
   virtual ~Source() { stop(); }
 
   virtual void start() {
+    // cpu_mu_ guards every access to thread_ (assignment here, the final
+    // sample in stop(), joinable()/native_handle() reads in
+    // thread_cpu_ns()) — std::thread itself is not atomic
+    std::lock_guard<std::mutex> g(cpu_mu_);
     running_.store(true);
     thread_ = std::thread([this] { run(); });
   }
   virtual void stop() {
-    bool was = running_.exchange(false);
-    if (was && thread_.joinable()) thread_.join();
+    // Sample the CPU clock and move the handle out under cpu_mu_, then
+    // join OUTSIDE the lock: a capture thread blocked in a long syscall
+    // must not stall stats readers (ig_sources_stats holds g_mu while
+    // waiting on cpu_mu_, so a held-across-join cpu_mu_ would freeze the
+    // whole C API behind one slow shutdown).
+    std::thread t;
+    {
+      std::lock_guard<std::mutex> g(cpu_mu_);
+      bool was = running_.exchange(false);
+      if (was && thread_.joinable()) {
+        sample_cpu_locked();
+        t = std::move(thread_);
+      }
+    }
+    if (t.joinable()) t.join();
   }
 
   size_t pop(Event* out, size_t n) { return ring_.pop(out, n); }
@@ -114,6 +132,22 @@ class Source {
     return filtered_.load(std::memory_order_relaxed);
   }
   Vocab& vocab() { return vocab_; }
+
+  // -- self-stats (the top/ebpf contract: per-program runtime via kernel
+  //    stats, pkg/gadgets/top/ebpf/tracer.go:55-418 + pkg/bpfstats) -------
+  void set_kind(uint32_t k) { kind_ = k; }
+  uint32_t kind() const { return kind_; }
+  uint64_t ring_len() const { return ring_.size(); }
+  uint64_t ring_capacity() const { return ring_.capacity(); }
+  uint64_t consumed() const { return ring_.consumed(); }
+  // CPU time consumed by this source's capture thread (ns); the analogue
+  // of BPF_ENABLE_STATS run_time_ns per program.
+  uint64_t thread_cpu_ns() {
+    std::lock_guard<std::mutex> g(cpu_mu_);
+    if (running_.load(std::memory_order_relaxed) && thread_.joinable())
+      sample_cpu_locked();
+    return last_cpu_ns_;
+  }
 
   // Capture-side container filter — the mntnsset-map analogue
   // (ref: pkg/tracer-collection/tracer-collection.go:100-134 keeps a per-
@@ -156,6 +190,23 @@ class Source {
   std::mutex filter_mu_;
   std::shared_ptr<const std::unordered_set<uint64_t>> filter_;
   std::atomic<uint64_t> filtered_{0};
+
+ private:
+  void sample_cpu_locked() {
+#ifdef __linux__
+    clockid_t cid;
+    if (pthread_getcpuclockid(thread_.native_handle(), &cid) == 0) {
+      struct timespec ts;
+      if (clock_gettime(cid, &ts) == 0)
+        last_cpu_ns_ =
+            (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+    }
+#endif
+  }
+
+  uint32_t kind_ = 0;
+  std::mutex cpu_mu_;
+  uint64_t last_cpu_ns_ = 0;
 };
 
 #ifdef __linux__

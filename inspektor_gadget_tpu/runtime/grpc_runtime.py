@@ -29,9 +29,12 @@ STOP_RESULT_TIMEOUT = 30.0  # ref: grpc-runtime.go:347-353
 class GrpcRuntime(Runtime):
     name = "grpc"
 
-    def __init__(self, targets: dict[str, str]):
-        """targets: node_name → grpc target (host:port or unix:///path)."""
+    def __init__(self, targets: dict[str, str], dialer_factory=None):
+        """targets: node_name → grpc target (host:port or unix:///path).
+        dialer_factory(node, target) -> Dialer lets fan-out reach agents
+        with no routable address (exec tunnels — k8s-exec-dialer.go)."""
         self.targets = targets
+        self.dialer_factory = dialer_factory
         self._clients: dict[str, Any] = {}
 
     def params(self) -> ParamDescs:
@@ -43,7 +46,10 @@ class GrpcRuntime(Runtime):
     def _client(self, node: str):
         from ..agent.client import AgentClient
         if node not in self._clients:
-            self._clients[node] = AgentClient(self.targets[node], node)
+            dialer = (self.dialer_factory(node, self.targets[node])
+                      if self.dialer_factory else None)
+            self._clients[node] = AgentClient(self.targets[node], node,
+                                              dialer=dialer)
         return self._clients[node]
 
     def close(self) -> None:
@@ -92,6 +98,12 @@ class GrpcRuntime(Runtime):
             # without this the agent renders result bytes per node as before
             outputs.append("combiner")
 
+        # cadence derives from the gadget's own interval param, TTL = 2
+        # ticks of that interval — the reference's parser.EnableSnapshots
+        # (interval, ttl=2) contract (grpc-runtime.go:196-202)
+        interval = 1.0
+        if is_interval and "interval" in ctx.gadget_params:
+            interval = ctx.gadget_params.get("interval").as_duration() or 1.0
         combiner = SnapshotCombiner(ttl_ticks=2) if is_interval else None
         # one-shot: accumulate every node's rows, flush once when all nodes
         # are done (ref: parser.EnableCombiner + Flush, grpc-runtime.go:204-207)
@@ -153,10 +165,6 @@ class GrpcRuntime(Runtime):
 
         ticker_stop = threading.Event()
         if combiner is not None and on_event_array is not None:
-            interval = 1.0
-            if "interval" in ctx.gadget_params:
-                interval = ctx.gadget_params.get("interval").as_duration() or 1.0
-
             def tick_loop():
                 while not ticker_stop.wait(interval):
                     on_event_array(combiner.get_snapshots())

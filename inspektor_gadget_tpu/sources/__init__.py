@@ -12,6 +12,7 @@ from .bridge import (
     NativeCapture,
     native_available,
     make_cfg,
+    sources_stats,
     SRC_SYNTH_EXEC,
     SRC_SYNTH_TCP,
     SRC_SYNTH_DNS,
@@ -30,7 +31,7 @@ from .synthetic import PySyntheticSource
 
 __all__ = [
     "EventBatch", "BATCH_COLUMNS",
-    "NativeCapture", "native_available", "make_cfg",
+    "NativeCapture", "native_available", "make_cfg", "sources_stats",
     "SRC_SYNTH_EXEC", "SRC_SYNTH_TCP", "SRC_SYNTH_DNS",
     "SRC_PROC_EXEC", "SRC_PROC_TCP",
     "SRC_FANOTIFY_EXEC", "SRC_FANOTIFY_OPEN", "SRC_MOUNTINFO",

@@ -106,6 +106,8 @@ def _load():
     lib.ig_synth_generate.restype = i64
     lib.ig_vocab_lookup.argtypes = [u64, u64, ctypes.c_char_p, i64]
     lib.ig_vocab_lookup.restype = i64
+    lib.ig_sources_stats.argtypes = [p64, p32] + [p64] * 7 + [i64]
+    lib.ig_sources_stats.restype = i64
     lib.ig_fanotify_supported.argtypes = []
     lib.ig_fanotify_supported.restype = ctypes.c_int
     lib.ig_containers_set.argtypes = [u64, ctypes.c_char_p, i64]
@@ -143,6 +145,52 @@ def containers_map_lookup(mntns: int) -> str:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+_SRC_KIND_NAMES = {
+    SRC_SYNTH_EXEC: "synth/exec", SRC_SYNTH_TCP: "synth/tcp",
+    SRC_SYNTH_DNS: "synth/dns", SRC_PROC_EXEC: "netlink/proc",
+    SRC_PROC_TCP: "proc/tcp", SRC_FANOTIFY_EXEC: "fanotify/exec",
+    SRC_FANOTIFY_OPEN: "fanotify/open", SRC_MOUNTINFO: "mountinfo",
+    SRC_SOCK_DIAG: "sock_diag", SRC_KMSG_OOM: "kmsg/oom",
+    SRC_PTRACE: "ptrace", SRC_FANOTIFY_RUNC: "fanotify/runc",
+    SRC_PERF_CPU: "perf/cpu", SRC_PKT_DNS: "pkt/dns",
+    SRC_PKT_SNI: "pkt/sni", SRC_PKT_FLOW: "pkt/flow",
+}
+
+
+def sources_stats(cap: int = 256) -> list[dict]:
+    """Enumerate every live native capture source with self-stats (the
+    top/ebpf contract: reference pkg/gadgets/top/ebpf/tracer.go:55-418 —
+    per-program runtime + counters; here per-source capture-thread CPU
+    time, ring occupancy/capacity, produced/consumed/drops/filtered)."""
+    lib = _load()
+    if lib is None:
+        return []
+    ids = np.zeros(cap, np.uint64)
+    kinds = np.zeros(cap, np.uint32)
+    cols = [np.zeros(cap, np.uint64) for _ in range(7)]
+    n = lib.ig_sources_stats(
+        _p64(ids), _p32(kinds), *[_p64(c) for c in cols], cap)
+    if n <= 0:
+        return []
+    produced, consumed, drops, filtered, ring_len, ring_cap, cpu_ns = cols
+    out = []
+    for i in range(int(n)):
+        k = int(kinds[i])
+        out.append({
+            "id": int(ids[i]),
+            "kind": k,
+            "kind_name": _SRC_KIND_NAMES.get(k, str(k)),
+            "produced": int(produced[i]),
+            "consumed": int(consumed[i]),
+            "drops": int(drops[i]),
+            "filtered": int(filtered[i]),
+            "ring_len": int(ring_len[i]),
+            "ring_cap": int(ring_cap[i]),
+            "cpu_ns": int(cpu_ns[i]),
+        })
+    return out
 
 
 def _p64(a: np.ndarray):

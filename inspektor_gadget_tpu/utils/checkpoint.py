@@ -31,11 +31,22 @@ def save_pytree(path: str | Path, tree) -> None:
 
 
 def load_pytree(path: str | Path, like):
-    """Restore into the structure of `like` (same config/shapes)."""
+    """Restore into the structure of `like` (same config/shapes). The
+    saved treedef string must match `like`'s — leaf count alone can't
+    tell a bundle from a scorer with the same number of arrays, and a
+    silent structure swap corrupts resumed state."""
     path = Path(path)
     with np.load(str(path.with_suffix(".npz"))) as z:
         leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
     like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    meta_path = path.with_suffix(".json")
+    if meta_path.exists():
+        meta = json.loads(meta_path.read_text())
+        saved_treedef = meta.get("treedef")
+        if saved_treedef is not None and saved_treedef != str(treedef):
+            raise ValueError(
+                f"checkpoint structure mismatch:\n  saved: {saved_treedef}\n"
+                f"  expected: {treedef}")
     if len(leaves) != len(like_leaves):
         raise ValueError(
             f"checkpoint has {len(leaves)} leaves, expected {len(like_leaves)}")

@@ -83,6 +83,20 @@ def test_checkpoint_roundtrip(tmp_path):
     assert float(more.events) == 6
 
 
+def test_checkpoint_structure_mismatch_rejected(tmp_path):
+    """A checkpoint saved from one structure must not silently unflatten
+    into a different `like` that happens to have the same leaf count —
+    the saved treedef is validated on load."""
+    import pytest as _pytest
+
+    from inspektor_gadget_tpu.utils.checkpoint import load_pytree, save_pytree
+
+    save_pytree(tmp_path / "pair", {"a": jnp.zeros(3), "b": jnp.ones(2)})
+    with _pytest.raises(ValueError, match="structure mismatch"):
+        load_pytree(tmp_path / "pair",
+                    {"x": jnp.zeros(3), "y": jnp.ones(2)})
+
+
 def test_stream_logger_severity_encoding():
     from inspektor_gadget_tpu.utils.logger import WARN, StreamLogger
 

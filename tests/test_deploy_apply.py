@@ -1,0 +1,168 @@
+"""Deploy apply + rollout wait + undeploy + exec-tunnel dialer.
+
+Reference contracts: cmd/kubectl-gadget/deploy.go:100-546 (apply manifests,
+wait for DaemonSet rollout), undeploy.go (delete them), and
+pkg/runtime/grpc/k8s-exec-dialer.go:1-132 (gRPC dialed over an exec
+stream's stdio). The cluster is the FakeClusterApplier double whose state
+lands in a pod-manifest file the pod informer watches — the full
+deploy → discovery → undeploy round-trip without a kube API.
+"""
+
+import sys
+import tempfile
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.cli.apply import (
+    FakeClusterApplier, deploy, manifest_kind_name, split_manifests, undeploy,
+)
+from inspektor_gadget_tpu.cli.deploy import render_manifests
+
+
+def test_split_manifests_and_kind_name():
+    docs = split_manifests(render_manifests())
+    kinds = [manifest_kind_name(d) for d in docs]
+    assert ("Namespace", "ig-tpu") in kinds
+    assert ("DaemonSet", "ig-tpu-agent") in kinds
+    assert ("ClusterRole", "ig-tpu-agent") in kinds
+    assert len(docs) == 5
+
+
+def test_deploy_applies_and_waits_for_rollout(tmp_path):
+    pod_file = str(tmp_path / "pods.json")
+    applier = FakeClusterApplier(pod_file, nodes=("node-a", "node-b"),
+                                 ready_after=2)  # ready on the 3rd poll
+    desired, ready = deploy(applier, render_manifests(),
+                            rollout_timeout=10.0, poll=0.05)
+    assert (desired, ready) == (2, 2)
+    assert ("DaemonSet", "ig-tpu-agent") in applier.applied
+    assert applier._status_polls >= 3  # rollout actually waited
+
+
+def test_deploy_rollout_timeout(tmp_path):
+    applier = FakeClusterApplier(str(tmp_path / "pods.json"),
+                                 ready_after=10**9)
+    with pytest.raises(TimeoutError):
+        deploy(applier, render_manifests(), rollout_timeout=0.3, poll=0.05)
+
+
+def test_deploy_discovery_undeploy_roundtrip(tmp_path):
+    """Applied DaemonSet → agent pods appear in the file-manifest pod
+    source → informer feeds a collection; undeploy removes them."""
+    from inspektor_gadget_tpu.containers import (
+        ContainerCollection, file_pod_source, with_pod_informer,
+    )
+
+    pod_file = str(tmp_path / "pods.json")
+    applier = FakeClusterApplier(pod_file, nodes=("node-a", "node-b"))
+    manifests = render_manifests()
+    deploy(applier, manifests, rollout_timeout=5.0, poll=0.05)
+
+    cc = ContainerCollection()
+    cc.initialize(with_pod_informer(file_pod_source(pod_file),
+                                    interval=0.1))
+    try:
+        deadline = time.time() + 3.0
+        while time.time() < deadline and len(cc) < 2:
+            time.sleep(0.05)
+        pods = {c.pod for c in cc.get_all()}
+        assert pods == {"ig-tpu-agent-node-a", "ig-tpu-agent-node-b"}
+
+        removed = undeploy(applier, manifests)
+        assert ("DaemonSet", "ig-tpu-agent") in removed
+        deadline = time.time() + 3.0
+        while time.time() < deadline and len(cc) > 0:
+            time.sleep(0.05)
+        assert len(cc) == 0, "undeployed pods still in the collection"
+    finally:
+        cc._pod_informer.stop()
+
+
+# ---------------------------------------------------------------------------
+# exec-tunnel dialer: real agent, gRPC over a subprocess's stdio
+# ---------------------------------------------------------------------------
+
+_BRIDGE = textwrap.dedent("""
+    import socket, sys, threading
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(sys.argv[1])
+    def out():
+        while True:
+            d = s.recv(65536)
+            if not d: break
+            sys.stdout.buffer.write(d); sys.stdout.buffer.flush()
+    t = threading.Thread(target=out, daemon=True); t.start()
+    while True:
+        d = sys.stdin.buffer.read1(65536)
+        if not d: break
+        s.sendall(d)
+    s.shutdown(socket.SHUT_WR); t.join(2)
+""")
+
+
+def test_exec_tunnel_dialer_runs_gadget():
+    """AgentClient over an ExecTunnelDialer whose subprocess bridges stdio
+    to the agent's unix socket — the kubectl-exec dial path with a python
+    stdio proxy standing in for kubectl."""
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    from inspektor_gadget_tpu.agent.dialer import ExecTunnelDialer
+    from inspektor_gadget_tpu.agent.service import serve
+
+    tmp = tempfile.mkdtemp()
+    sock = f"{tmp}/agent.sock"
+    server, _ = serve(f"unix://{sock}", node_name="tunneled")
+    dialer = ExecTunnelDialer([sys.executable, "-S", "-c", _BRIDGE, sock])
+    client = AgentClient("tunneled-agent", "tunneled", dialer=dialer)
+    try:
+        cat = client.get_catalog()
+        assert any(g["name"] == "exec" for g in cat["gadgets"])
+        rows = []
+        res = client.run_gadget(
+            "trace", "exec",
+            {"gadget.source": "pysynthetic", "gadget.rate": "20000",
+             "gadget.batch-size": "256"},
+            timeout=1.0, on_json=lambda node, row: rows.append(row))
+        assert res["error"] is None
+        assert len(rows) > 10
+        assert rows[0]["node"] == "tunneled"
+    finally:
+        client.close()
+        server.stop(grace=0.5)
+
+
+def test_grpc_runtime_dialer_factory():
+    """GrpcRuntime fans out through per-node dialers when a factory is
+    given (the runtime-level seam)."""
+    from inspektor_gadget_tpu.agent.dialer import ExecTunnelDialer
+    from inspektor_gadget_tpu.agent.service import serve
+    from inspektor_gadget_tpu.gadgets import GadgetContext, get
+    from inspektor_gadget_tpu.runtime import GrpcRuntime
+
+    tmp = tempfile.mkdtemp()
+    sock = f"{tmp}/agent.sock"
+    server, _ = serve(f"unix://{sock}", node_name="node-t")
+
+    made = []
+
+    def factory(node, target):
+        d = ExecTunnelDialer([sys.executable, "-S", "-c", _BRIDGE, sock])
+        made.append(node)
+        return d
+
+    runtime = GrpcRuntime({"node-t": "tunnel:opaque"}, dialer_factory=factory)
+    desc = get("trace", "exec")
+    params = desc.params().to_params()
+    params.set("source", "pysynthetic")
+    params.set("rate", "10000")
+    ctx = GadgetContext(desc, gadget_params=params, timeout=1.0)
+    events = []
+    result = runtime.run_gadget(ctx, on_event=events.append)
+    runtime.close()
+    server.stop(grace=0.5)
+    assert made == ["node-t"]
+    assert not result.errors()
+    assert events and events[0].node == "node-t"
