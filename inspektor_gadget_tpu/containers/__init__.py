@@ -13,6 +13,8 @@ from .tracer_collection import TracerCollection
 from .options import (
     with_fake_containers,
     with_fallback_pod_informer,
+    with_host,
+    with_oci_config_enrichment,
     with_pod_informer,
     with_procfs_discovery,
     with_node_name,
@@ -20,6 +22,14 @@ from .options import (
     with_linux_namespace_enrichment,
 )
 from .podinformer import PodInformer, file_pod_source, kube_api_pod_source
+from .runtime_client import (
+    ContainerdClient,
+    CriClient,
+    CriGrpcClient,
+    DockerClient,
+    detect_runtime_client,
+    with_runtime_enrichment,
+)
 
 __all__ = [
     "Container", "ContainerSelector",
@@ -28,5 +38,8 @@ __all__ = [
     "with_fake_containers", "with_procfs_discovery", "with_node_name",
     "with_cgroup_enrichment", "with_linux_namespace_enrichment",
     "with_pod_informer", "with_fallback_pod_informer",
+    "with_host", "with_oci_config_enrichment", "with_runtime_enrichment",
     "PodInformer", "file_pod_source", "kube_api_pod_source",
+    "ContainerdClient", "CriClient", "CriGrpcClient", "DockerClient",
+    "detect_runtime_client",
 ]

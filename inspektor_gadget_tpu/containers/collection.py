@@ -47,12 +47,22 @@ class ContainerCollection:
     # -- initialization (ref: Initialize + functional options :81-116) ------
 
     def initialize(self, *options: Callable[["ContainerCollection"], None]) -> None:
+        """Apply options in order. An option may return a callable: those
+        run after ALL options are applied — discovery/seeding phases use
+        this so every enricher is installed before the first add_container
+        (ref: options are pure setup in options.go; initial-container
+        seeding happens once the collection is fully assembled)."""
+        post: list[Callable[[], None]] = []
         with self._mu:
             if self._initialized:
                 raise RuntimeError("ContainerCollection already initialized")
             for opt in options:
-                opt(self)
+                r = opt(self)
+                if callable(r):
+                    post.append(r)
             self._initialized = True
+        for fn in post:
+            fn()
 
     def add_enricher(self, fn: Callable[[Container], bool]) -> None:
         """Enrichers run on every added container; returning False drops it

@@ -27,9 +27,13 @@ class Container:
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
     runtime: str = ""
     host_network: bool = False
-    # OCI extras
+    # OCI extras (filled by with_oci_config_enrichment from the bundle's
+    # config.json — ref options.go:628 WithOCIConfigEnrichment)
     oci_image: str = ""
     seccomp_profile: str = ""
+    mounts: list = dataclasses.field(default_factory=list)
+    env: list = dataclasses.field(default_factory=list)
+    bundle: str = ""
 
 
 @dataclasses.dataclass

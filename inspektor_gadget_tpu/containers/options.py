@@ -86,6 +86,60 @@ def with_linux_namespace_enrichment():
     return opt
 
 
+def with_oci_config_enrichment(bundle_root: str = ""):
+    """Fill mounts/env/annotations/seccomp from the container's OCI bundle
+    config.json (ref: options.go:628 WithOCIConfigEnrichment — the
+    reference parses the runtime-spec config the hook/fanotify path found).
+    The bundle comes from c.bundle (set by runtime clients / runc
+    fanotify); bundle_root lets tests point at a fake tree keyed by id."""
+
+    def enrich(c: Container) -> bool:
+        path = ""
+        if c.bundle:
+            path = os.path.join(c.bundle, "config.json")
+        elif bundle_root:
+            path = os.path.join(bundle_root, c.id, "config.json")
+        if not path:
+            return True
+        try:
+            import json
+            with open(path) as f:
+                cfg = json.load(f)
+        except (OSError, ValueError):
+            return True
+        if not c.mounts:
+            c.mounts = [m.get("destination", "") for m in
+                        cfg.get("mounts", []) if m.get("destination")]
+        if not c.env:
+            c.env = list(cfg.get("process", {}).get("env", []))
+        for k, v in cfg.get("annotations", {}).items():
+            c.labels.setdefault(k, v)
+        sec = cfg.get("linux", {}).get("seccomp")
+        if sec and not c.seccomp_profile:
+            c.seccomp_profile = sec.get("defaultAction", "")
+        return True
+
+    def opt(cc: ContainerCollection):
+        cc.add_enricher(enrich)
+
+    return opt
+
+
+def with_host():
+    """Add a pseudo-container for the host itself (ref: options.go:303
+    WithHost) so host (non-container) events resolve to a stable identity:
+    id 'host', pid 1, the init process's namespaces."""
+
+    def opt(cc: ContainerCollection):
+        host = Container(id="host", name="host", pid=1, runtime="host",
+                         host_network=True)
+        host.mntns = _read_ns(1, "mnt")  # 0 when /proc/1/ns is unreadable
+        host.netns = _read_ns(1, "net")
+        cc.add_container(host)
+
+    return opt
+
+
 def with_fanotify_discovery(paths: str = ""):
     """Live container detection via the native fanotify exec-watch on
     container-runtime binaries (ref: options.go:533 WithRuncFanotify →

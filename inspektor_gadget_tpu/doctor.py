@@ -141,6 +141,17 @@ def _probe_af_packet() -> Window:
                       f"AF_PACKET: {e.strerror} (needs CAP_NET_RAW)")
 
 
+def _probe_blktrace() -> Window:
+    try:
+        from .sources.bridge import blktrace_supported
+        ok = blktrace_supported()
+        return Window("blktrace", ok,
+                      "tracefs block events readable" if ok else
+                      "tracefs block events unavailable (mount tracefs)")
+    except Exception as e:  # noqa: BLE001
+        return Window("blktrace", False, repr(e))
+
+
 def _probe_mountinfo() -> Window:
     try:
         with open("/proc/self/mountinfo") as f:
@@ -163,7 +174,7 @@ def _probe_procfs() -> Window:
 _PROBES = (
     _probe_native_lib, _probe_fanotify, _probe_perf, _probe_kmsg,
     _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
-    _probe_mountinfo, _probe_procfs,
+    _probe_mountinfo, _probe_procfs, _probe_blktrace,
 )
 
 
@@ -215,8 +226,8 @@ def _source_windows() -> dict[int, tuple[str, str, str]]:
 _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
     ("profile", "cpu"): ("perf", "procfs",
                          "49Hz callchains; procfs stat-delta fallback"),
-    ("profile", "block-io"): ("procfs", "",
-                              "diskstats windowed latency"),
+    ("profile", "block-io"): ("blktrace", "procfs",
+                              "per-IO tracefs latency; diskstats fallback"),
     ("top", "file"): ("procfs", "", "/proc/<pid>/io deltas"),
     ("top", "tcp"): ("procfs", "", "/proc/net drains"),
     ("top", "block-io"): ("procfs", "", "/proc/diskstats deltas"),

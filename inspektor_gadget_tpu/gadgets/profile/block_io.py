@@ -1,11 +1,15 @@
 """profile/block-io — block I/O latency histogram.
 
-Reference: pkg/gadgets/profile/block-io (biolatency.bpf.c log2 latency
-histogram accumulated in a BPF map on rq issue→complete; RunWithResult
-renders an ASCII histogram). Native analogue: sample /proc/diskstats at
-high frequency; each window's completed-IO count and queue-time delta give
-a per-window average latency observation weighted by IO count, folded into
-the same log2-bucket ASCII histogram (usecs buckets).
+Reference: pkg/gadgets/profile/block-io (biolatency.bpf.c:1-156 — log2
+latency histogram accumulated in a BPF map on rq issue→complete;
+RunWithResult renders an ASCII histogram).
+
+Two windows, per-IO preferred:
+  blktrace   native tracefs block events (BlkTraceSource): every request's
+             issue→complete latency lands in its own log2 bucket — the
+             true per-IO distribution biolatency measures
+  diskstats  degraded flavour (labeled in the output): /proc/diskstats
+             sampling gives a per-window average weighted by IO count
 """
 
 from __future__ import annotations
@@ -13,9 +17,14 @@ from __future__ import annotations
 import time
 
 from ...params import ParamDesc, ParamDescs, TypeHint
+from ...sources.bridge import (
+    SRC_BLK_TRACE, NativeCapture, blktrace_supported,
+)
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
 from ..top.block_io import _read_diskstats
+
+EV_BLOCK_IO = 15
 
 
 def render_log2_hist(buckets: list[int], unit: str = "usecs") -> bytes:
@@ -43,8 +52,55 @@ class ProfileBlockIo:
         p = ctx.gadget_params
         self.quantiles = (p.get("quantiles").as_bool()
                           if p and "quantiles" in p else False)
+        self.window = (p.get("window").as_string()
+                       if p and "window" in p else "auto")
 
     def run_with_result(self, ctx) -> bytes:
+        mode = self.window
+        if mode == "auto":
+            mode = "blktrace" if blktrace_supported() else "diskstats"
+        if mode == "blktrace":
+            if not blktrace_supported():
+                raise RuntimeError(
+                    "profile/block-io: tracefs block events unavailable "
+                    "(mount tracefs or use --window diskstats)")
+            return self._run_blktrace(ctx)
+        return self._run_diskstats(ctx)
+
+    # -- per-IO window (biolatency parity) ----------------------------------
+
+    def _run_blktrace(self, ctx) -> bytes:
+        buckets = [0] * 32
+        pending: list[tuple[float, int]] = []
+        sketch = None
+        src = NativeCapture(SRC_BLK_TRACE, ring_pow2=16)
+        with src:
+            while not ctx.done:
+                if ctx.sleep_or_done(0.05):
+                    break
+                b = src.pop()
+                c = b.cols
+                for i in range(b.count):
+                    if int(c["kind"][i]) != EV_BLOCK_IO:
+                        continue
+                    lat_us = max(int(c["aux1"][i]), 1)
+                    buckets[min(lat_us.bit_length(), 31)] += 1
+                    if self.quantiles:
+                        pending.append((lat_us / 1e6, 1))
+                if len(pending) >= self._FLUSH:
+                    sketch = self._fold(sketch, pending)
+                    pending = []
+        if pending:
+            sketch = self._fold(sketch, pending)
+        out = render_log2_hist(buckets)
+        out += b"\nsource: tracefs block events (per-IO)\n"
+        if sketch is not None:
+            out += self._quantile_summary(sketch)
+        return out
+
+    # -- degraded flavour: windowed diskstats averages ----------------------
+
+    def _run_diskstats(self, ctx) -> bytes:
         buckets = [0] * 32
         # pending (latency_s, weight) since the last sketch fold; flushed
         # every _FLUSH ticks so memory stays O(n_buckets), not O(runtime) —
@@ -74,6 +130,8 @@ class ProfileBlockIo:
         if pending:
             sketch = self._fold(sketch, pending)
         out = render_log2_hist(buckets)
+        out += (b"\nsource: diskstats sampling (windowed averages, "
+                b"degraded; per-IO needs tracefs)\n")
         if sketch is not None:
             out += self._quantile_summary(sketch)
         return out
@@ -119,6 +177,10 @@ class ProfileBlockIoDesc(GadgetDesc):
             ParamDesc(key="quantiles", default="false",
                       type_hint=TypeHint.BOOL,
                       description="append mergeable DDSketch p50/p95/p99"),
+            ParamDesc(key="window", default="auto",
+                      possible_values=("auto", "blktrace", "diskstats"),
+                      description="per-IO tracefs window or windowed "
+                                  "diskstats averages"),
         ])
 
     def new_instance(self, ctx) -> ProfileBlockIo:

@@ -52,6 +52,7 @@ enum {
   IG_SRC_PTRACE = 108,
   IG_SRC_FANOTIFY_RUNC = 109,
   IG_SRC_PERF_CPU = 110,
+  IG_SRC_BLK_TRACE = 111,
   IG_SRC_PKT_DNS = 200,
   IG_SRC_PKT_SNI = 201,
   IG_SRC_PKT_FLOW = 202,
@@ -148,6 +149,9 @@ uint64_t ig_source_create_cfg(uint32_t kind, const char* cfg,
     case IG_SRC_PERF_CPU:
       s = new PerfCpuSampler(cap, c);
       break;
+    case IG_SRC_BLK_TRACE:
+      s = new BlkTraceSource(cap, c);
+      break;
     default:
       return 0;
   }
@@ -222,6 +226,15 @@ int ig_ptrace_exit_status(uint64_t h) {
 int ig_perf_supported() {
 #ifdef __linux__
   return PerfCpuSampler::supported() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// Per-IO block window available? (tracefs block events readable)
+int ig_blktrace_supported() {
+#ifdef __linux__
+  return BlkTraceSource::supported() ? 1 : 0;
 #else
   return 0;
 #endif

@@ -59,9 +59,18 @@ class LocalManager(Operator):
         return True
 
     def init(self, global_params: Params) -> None:
+        from ..containers import (
+            with_oci_config_enrichment, with_runtime_enrichment,
+        )
         self.cc = ContainerCollection()
         opts = [with_node_name(global_params.get("node-name").as_string()
                                if "node-name" in global_params else "local")]
+        # runtime auto-chain first (completes hook-shaped adds with
+        # pid/name from docker/containerd/CRI — options.go:132-197), then
+        # OCI-config enrichment (mounts/env/annotations from the bundle),
+        # then namespace resolution; all silently degrade when absent
+        opts.append(with_runtime_enrichment())
+        opts.append(with_oci_config_enrichment())
         if ("containerd-like-discovery" in global_params
                 and global_params.get("containerd-like-discovery").as_string() == "procfs"):
             opts.append(with_linux_namespace_enrichment())

@@ -33,13 +33,14 @@ SRC_KMSG_OOM = 106
 SRC_PTRACE = 108
 SRC_FANOTIFY_RUNC = 109
 SRC_PERF_CPU = 110
+SRC_BLK_TRACE = 111
 SRC_PKT_DNS = 200
 SRC_PKT_SNI = 201
 SRC_PKT_FLOW = 202
 
 # kinds that take a "key=value\x1f..." config string (create_cfg path)
 _CFG_KINDS = {SRC_FANOTIFY_OPEN, SRC_MOUNTINFO, SRC_SOCK_DIAG, SRC_KMSG_OOM,
-              SRC_PTRACE, SRC_FANOTIFY_RUNC, SRC_PERF_CPU}
+              SRC_PTRACE, SRC_FANOTIFY_RUNC, SRC_PERF_CPU, SRC_BLK_TRACE}
 
 
 def make_cfg(**kw) -> str:
@@ -66,15 +67,30 @@ def _load():
     if _lib is not None or _lib_err is not None:
         return _lib
     try:
-        if not _LIB_PATH.exists():
-            subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR)],
-                check=True, capture_output=True, text=True,
-            )
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib = _load_and_bind(rebuild=not _LIB_PATH.exists())
+    except AttributeError:
+        # a stale libigcapture.so from before a symbol was added: force a
+        # rebuild once, then rebind — else every native call would crash
+        # instead of degrading
+        try:
+            lib = _load_and_bind(rebuild=True)
+        except (OSError, subprocess.CalledProcessError, AttributeError) as e:
+            _lib_err = str(e)
+            return None
     except (OSError, subprocess.CalledProcessError) as e:
         _lib_err = str(e)
         return None
+    _lib = lib
+    return lib
+
+
+def _load_and_bind(rebuild: bool):
+    if rebuild:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR), "-B"],
+            check=True, capture_output=True, text=True,
+        )
+    lib = ctypes.CDLL(str(_LIB_PATH))
 
     u64, u32, i64, f64 = (ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int64,
                           ctypes.c_double)
@@ -92,6 +108,8 @@ def _load():
     lib.ig_ptrace_exit_status.restype = ctypes.c_int
     lib.ig_perf_supported.argtypes = []
     lib.ig_perf_supported.restype = ctypes.c_int
+    lib.ig_blktrace_supported.argtypes = []
+    lib.ig_blktrace_supported.restype = ctypes.c_int
     for fn in ("ig_source_start", "ig_source_stop", "ig_source_destroy"):
         getattr(lib, fn).argtypes = [u64]
         getattr(lib, fn).restype = ctypes.c_int
@@ -115,7 +133,6 @@ def _load():
     lib.ig_containers_lookup.argtypes = [u64, ctypes.c_char_p, i64]
     lib.ig_containers_lookup.restype = i64
     lib.ig_containers_count.restype = i64
-    _lib = lib
     return lib
 
 
@@ -147,6 +164,12 @@ def native_available() -> bool:
     return _load() is not None
 
 
+def blktrace_supported() -> bool:
+    """Per-IO block window (tracefs block events) available on this host."""
+    lib = _load()
+    return lib is not None and bool(lib.ig_blktrace_supported())
+
+
 _SRC_KIND_NAMES = {
     SRC_SYNTH_EXEC: "synth/exec", SRC_SYNTH_TCP: "synth/tcp",
     SRC_SYNTH_DNS: "synth/dns", SRC_PROC_EXEC: "netlink/proc",
@@ -154,7 +177,8 @@ _SRC_KIND_NAMES = {
     SRC_FANOTIFY_OPEN: "fanotify/open", SRC_MOUNTINFO: "mountinfo",
     SRC_SOCK_DIAG: "sock_diag", SRC_KMSG_OOM: "kmsg/oom",
     SRC_PTRACE: "ptrace", SRC_FANOTIFY_RUNC: "fanotify/runc",
-    SRC_PERF_CPU: "perf/cpu", SRC_PKT_DNS: "pkt/dns",
+    SRC_PERF_CPU: "perf/cpu", SRC_BLK_TRACE: "blk/trace",
+    SRC_PKT_DNS: "pkt/dns",
     SRC_PKT_SNI: "pkt/sni", SRC_PKT_FLOW: "pkt/flow",
 }
 

@@ -2,6 +2,8 @@
 integration matchers, SURVEY §4)."""
 
 import json
+import os
+import time
 
 import pytest
 
@@ -90,6 +92,49 @@ def test_top_file_emits_arrays():
 def test_profile_blockio_histogram_renders():
     result, _, _ = run_gadget("profile", "block-io", timeout=0.8)
     assert b"usecs" in result and b"distribution" in result
+    # the output names its window so degraded data is never mistaken
+    # for the per-IO distribution
+    assert b"source:" in result
+
+
+def test_profile_blockio_diskstats_flavour_labeled():
+    result, _, _ = run_gadget("profile", "block-io", timeout=0.6,
+                              param_overrides={"window": "diskstats"})
+    assert b"degraded" in result
+
+
+def test_profile_blockio_per_io_distribution():
+    """With the tracefs window, every IO lands in its own latency bucket —
+    a real distribution, not a windowed average (biolatency.bpf.c parity)."""
+    import subprocess
+    import threading
+
+    from inspektor_gadget_tpu.sources.bridge import blktrace_supported
+    if not blktrace_supported() or os.geteuid() != 0:
+        pytest.skip("tracefs block events unavailable")
+
+    def io_load():
+        time.sleep(0.5)
+        for _ in range(3):
+            subprocess.run(
+                ["dd", "if=/dev/zero", "of=/tmp/ig_blk_g", "bs=4096",
+                 "count=64", "oflag=direct"],
+                stderr=subprocess.DEVNULL, check=False)
+
+    t = threading.Thread(target=io_load)
+    t.start()
+    try:
+        result, _, _ = run_gadget(
+            "profile", "block-io", timeout=3.0,
+            param_overrides={"window": "blktrace"})
+    finally:
+        t.join()
+    assert b"per-IO" in result
+    # at least ~100 IOs counted individually across the histogram
+    counts = [int(line.split(":")[1].split("|")[0])
+              for line in result.decode().splitlines()
+              if "->" in line and ":" in line]
+    assert sum(counts) >= 100, result.decode()
 
 
 def test_profile_blockio_quantiles_param():

@@ -1,0 +1,235 @@
+"""Runtime clients + enrichment options.
+
+Reference contracts: pkg/container-utils/containerd/containerd.go (task
+state: id/pid/bundle), cri/cri.go:1-295 (ListContainers + verbose
+ContainerStatus, pid parsed from the info JSON), and
+pkg/container-collection/options.go:132-197 (runtime enrichment
+auto-chain), :303 (WithHost), :628 (WithOCIConfigEnrichment). Every
+backend degrades gracefully when its socket/dir is absent.
+"""
+
+import json
+import os
+import tempfile
+from concurrent import futures
+
+import grpc
+import pytest
+
+from inspektor_gadget_tpu.containers import (
+    Container, ContainerCollection, ContainerdClient, CriGrpcClient,
+    with_host, with_oci_config_enrichment, with_runtime_enrichment,
+)
+from inspektor_gadget_tpu.containers import cri_pb2
+
+
+# ---------------------------------------------------------------------------
+# containerd: on-disk runtime-v2 task state
+# ---------------------------------------------------------------------------
+
+def _fake_task(root, ns, cid, pid, annotations):
+    d = os.path.join(root, ns, cid)
+    os.makedirs(d)
+    with open(os.path.join(d, "init.pid"), "w") as f:
+        f.write(str(pid))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({"annotations": annotations,
+                   "process": {"env": ["A=1"]},
+                   "mounts": [{"destination": "/etc/hosts"}]}, f)
+
+
+def test_containerd_client_reads_task_state(tmp_path):
+    root = str(tmp_path)
+    _fake_task(root, "k8s.io", "abcdef123456789", 4242, {
+        "io.kubernetes.cri.container-name": "web",
+        "io.kubernetes.cri.sandbox-name": "pod-1",
+        "io.kubernetes.cri.sandbox-namespace": "prod",
+    })
+    _fake_task(root, "moby", "fedcba987654321", 4343, {})
+    client = ContainerdClient(task_root=root)
+    assert client.available()
+    got = {c.id: c for c in client.get_containers()}
+    assert len(got) == 2
+    web = got["abcdef123456"]
+    assert (web.name, web.pid, web.pod, web.namespace, web.runtime) == \
+        ("web", 4242, "pod-1", "prod", "containerd")
+    assert web.bundle.endswith("abcdef123456789")
+    # lookup by full id prefix
+    assert client.get_container("abcdef123456789").name == "web"
+
+
+def test_containerd_client_degrades_without_root(tmp_path):
+    client = ContainerdClient(task_root=str(tmp_path / "nope"))
+    assert not client.available()
+    assert client.get_containers() == []
+
+
+# ---------------------------------------------------------------------------
+# CRI over gRPC against a fake CRI server (the real wire path)
+# ---------------------------------------------------------------------------
+
+class _FakeCri:
+    def __init__(self):
+        self.containers = [
+            ("c1" * 16, "web", {"io.kubernetes.pod.name": "pod-a",
+                                "io.kubernetes.pod.namespace": "ns-a"}, 111),
+            ("d2" * 16, "db", {}, 222),
+        ]
+
+    def version(self, request: bytes, ctx) -> bytes:
+        return cri_pb2.VersionResponse(
+            version="0.1.0", runtime_name="fake-cri",
+            runtime_version="1.0", runtime_api_version="v1",
+        ).SerializeToString()
+
+    def list_containers(self, request: bytes, ctx) -> bytes:
+        req = cri_pb2.ListContainersRequest.FromString(request)
+        assert req.filter.state.state == cri_pb2.CONTAINER_RUNNING
+        resp = cri_pb2.ListContainersResponse()
+        for cid, name, labels, _pid in self.containers:
+            c = resp.containers.add()
+            c.id = cid
+            c.metadata.name = name
+            c.state = cri_pb2.CONTAINER_RUNNING
+            for k, v in labels.items():
+                c.labels[k] = v
+        return resp.SerializeToString()
+
+    def container_status(self, request: bytes, ctx) -> bytes:
+        req = cri_pb2.ContainerStatusRequest.FromString(request)
+        assert req.verbose
+        match = next(((n, l, p) for cid, n, l, p in self.containers
+                      if cid == req.container_id), None)
+        resp = cri_pb2.ContainerStatusResponse()
+        if match is None:
+            return resp.SerializeToString()
+        name, labels, pid = match
+        resp.status.id = req.container_id
+        resp.status.metadata.name = name
+        for k, v in labels.items():
+            resp.status.labels[k] = v
+        resp.info["info"] = json.dumps({"pid": pid, "sandboxID": "s1"})
+        return resp.SerializeToString()
+
+
+@pytest.fixture()
+def fake_cri_socket():
+    tmp = tempfile.mkdtemp()
+    sock = f"{tmp}/cri.sock"
+    fake = _FakeCri()
+    ident = lambda b: b  # noqa: E731
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    handlers = {
+        "Version": grpc.unary_unary_rpc_method_handler(
+            fake.version, request_deserializer=ident,
+            response_serializer=ident),
+        "ListContainers": grpc.unary_unary_rpc_method_handler(
+            fake.list_containers, request_deserializer=ident,
+            response_serializer=ident),
+        "ContainerStatus": grpc.unary_unary_rpc_method_handler(
+            fake.container_status, request_deserializer=ident,
+            response_serializer=ident),
+    }
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler("runtime.v1.RuntimeService",
+                                             handlers),
+    ))
+    server.add_insecure_port(f"unix://{sock}")
+    server.start()
+    yield sock
+    server.stop(grace=0.2)
+
+
+def test_cri_grpc_client_lists_with_pids(fake_cri_socket):
+    client = CriGrpcClient(socket_path=fake_cri_socket)
+    assert client.available()
+    assert client.version() == "fake-cri"
+    got = {c.name: c for c in client.get_containers()}
+    assert set(got) == {"web", "db"}
+    assert got["web"].pid == 111 and got["db"].pid == 222
+    assert got["web"].pod == "pod-a" and got["web"].namespace == "ns-a"
+    assert got["web"].runtime == "cri"
+    assert client.get_container("c1" * 16).name == "web"
+
+
+def test_cri_grpc_client_degrades_without_socket(tmp_path):
+    client = CriGrpcClient(socket_path=str(tmp_path / "absent.sock"))
+    assert not client.available()
+
+
+# ---------------------------------------------------------------------------
+# enrichment options
+# ---------------------------------------------------------------------------
+
+class _FakeRuntime:
+    """Duck-typed RuntimeClient backed by a dict."""
+
+    def __init__(self, containers):
+        self.by_id = {c.id: c for c in containers}
+
+    def available(self):
+        return True
+
+    def get_containers(self):
+        return list(self.by_id.values())
+
+    def get_container(self, cid):
+        return self.by_id.get(cid[:12])
+
+
+def test_runtime_enrichment_auto_chain():
+    """A container added with only an id (the OCI-hook shape) is completed
+    from the runtime client (options.go:132-197 semantics)."""
+    full = Container(id="aaa111bbb222", name="web", pid=os.getpid(),
+                     namespace="ns", pod="pod-x", runtime="fake",
+                     labels={"team": "infra"})
+    cc = ContainerCollection()
+    cc.initialize(with_runtime_enrichment(client=_FakeRuntime([full])))
+    # seeded from the runtime
+    assert cc.get("aaa111bbb222").name == "web"
+    cc.remove_container("aaa111bbb222")
+    # hook-shaped add: id only → enricher completes it
+    cc.add_container(Container(id="aaa111bbb222"))
+    got = cc.get("aaa111bbb222")
+    assert (got.name, got.pid, got.pod, got.labels["team"]) == \
+        ("web", os.getpid(), "pod-x", "infra")
+    # namespace enrichment chained: pid → mntns resolved
+    assert got.mntns > 0
+
+
+def test_runtime_enrichment_keeps_unknown_containers():
+    cc = ContainerCollection()
+    cc.initialize(with_runtime_enrichment(client=_FakeRuntime([])))
+    cc.add_container(Container(id="unknown-to-runtime", name="manual",
+                               pid=os.getpid()))
+    assert cc.get("unknown-to-runtime").name == "manual"
+
+
+def test_oci_config_enrichment(tmp_path):
+    bundle = tmp_path / "c9"
+    bundle.mkdir()
+    (bundle / "config.json").write_text(json.dumps({
+        "process": {"env": ["PATH=/usr/bin", "MODE=prod"]},
+        "mounts": [{"destination": "/data"}, {"destination": "/etc/ssl"}],
+        "annotations": {"org.opencontainers.image.ref.name": "img:1"},
+        "linux": {"seccomp": {"defaultAction": "SCMP_ACT_ERRNO"}},
+    }))
+    cc = ContainerCollection()
+    cc.initialize(with_oci_config_enrichment(bundle_root=str(tmp_path)))
+    cc.add_container(Container(id="c9", name="app", pid=os.getpid()))
+    got = cc.get("c9")
+    assert got.mounts == ["/data", "/etc/ssl"]
+    assert "MODE=prod" in got.env
+    assert got.labels["org.opencontainers.image.ref.name"] == "img:1"
+    assert got.seccomp_profile == "SCMP_ACT_ERRNO"
+
+
+def test_with_host_adds_host_pseudo_container():
+    cc = ContainerCollection()
+    cc.initialize(with_host())
+    host = cc.get("host")
+    assert host is not None and host.pid == 1 and host.runtime == "host"
+    # pid 1's namespaces aren't always readable (sandboxed /proc); only
+    # assert the mntns index when the probe could resolve it
+    if host.mntns:
+        assert cc.lookup_by_mntns(host.mntns).id == "host"
