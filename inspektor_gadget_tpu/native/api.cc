@@ -323,6 +323,15 @@ int64_t ig_synth_generate(uint64_t h, int64_t n, uint64_t* key_hash,
   return n;
 }
 
+// Folded fast path: zipf draws land as xor-folded uint32 keys directly in
+// the caller's staging buffer (the sketch plane's native key width).
+int64_t ig_synth_generate_folded(uint64_t h, int64_t n, uint32_t* out) {
+  Source* s = lookup(h);
+  auto* syn = dynamic_cast<SyntheticSource*>(s);
+  if (!syn || n <= 0 || !out) return -1;
+  return (int64_t)syn->generate_folded(out, (size_t)n);
+}
+
 int64_t ig_vocab_lookup(uint64_t h, uint64_t key, char* out, int64_t cap) {
   Source* s = lookup(h);
   if (!s || cap <= 0) return -1;

@@ -302,6 +302,20 @@ class SyntheticSource : public Source {
     return n;
   }
 
+  // Folded-uint32 fast path: the sketch plane consumes xor-folded uint32
+  // keys, so fold once per vocab entry and emit draws straight into the
+  // caller's H2D staging buffer — no 64-byte Event structs, no separate
+  // numpy fold pass. One alias draw + one table load per event.
+  size_t generate_folded(uint32_t* out, size_t n) {
+    if (folded_.empty()) {
+      folded_.reserve(hashes_.size());
+      for (uint64_t h : hashes_)
+        folded_.push_back((uint32_t)((h >> 32) ^ (h & 0xFFFFFFFFull)));
+    }
+    for (size_t i = 0; i < n; i++) out[i] = folded_[zipf_draw()];
+    return n;
+  }
+
  protected:
   void run() override {
     // Paced producer: emit in 1ms chunks at the requested rate.
@@ -358,6 +372,7 @@ class SyntheticSource : public Source {
   std::vector<uint32_t> alias_idx_;
   std::vector<std::string> names_;
   std::vector<uint64_t> hashes_;
+  std::vector<uint32_t> folded_;
 };
 
 #ifdef __linux__

@@ -122,6 +122,8 @@ def _load_and_bind(rebuild: bool):
     lib.ig_source_produced.restype = u64
     lib.ig_synth_generate.argtypes = [u64, i64, p64, p64, p32, p32]
     lib.ig_synth_generate.restype = i64
+    lib.ig_synth_generate_folded.argtypes = [u64, i64, p32]
+    lib.ig_synth_generate_folded.restype = i64
     lib.ig_vocab_lookup.argtypes = [u64, u64, ctypes.c_char_p, i64]
     lib.ig_vocab_lookup.restype = i64
     lib.ig_sources_stats.argtypes = [p64, p32] + [p64] * 7 + [i64]
@@ -314,6 +316,17 @@ class NativeCapture:
         b.cols["kind"][: b.count] = ev_kind
         b.cols["ts"][: b.count] = np.uint64(time.time_ns())
         return b
+
+    def generate_folded(self, n: int, out: np.ndarray | None = None) -> np.ndarray:
+        """Synchronous synthetic generation of xor-folded uint32 keys (the
+        sketch plane's native width) straight into a staging buffer — no
+        Event structs, no separate fold pass (bench hot path)."""
+        if out is None or out.size < n:
+            out = np.empty(n, dtype=np.uint32)
+        got = self._lib.ig_synth_generate_folded(self._h, n, _p32(out))
+        if got < 0:
+            raise RuntimeError("generate_folded on non-synthetic source")
+        return out[:got]
 
     def drops(self) -> int:
         return int(self._lib.ig_source_drops(self._h))

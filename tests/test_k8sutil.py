@@ -1,0 +1,96 @@
+"""k8sutil KubeClient against a fake apiserver (ref: pkg/k8sutil — the
+clientset constructor; here credential resolution + typed REST helpers)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from inspektor_gadget_tpu.utils.k8s import KubeClient, pod_source_from_client
+
+_PODS = {"items": [{
+    "metadata": {"name": "ig-agent-a", "namespace": "ig-tpu",
+                 "uid": "u1", "labels": {"k8s-app": "ig-tpu-agent"}},
+    "spec": {"nodeName": "node-a", "hostNetwork": True,
+             "containers": [{"name": "agent", "image": "ig:latest"}]},
+    "status": {"containerStatuses": [
+        {"name": "agent", "containerID": "containerd://deadbeef1234"}]},
+}]}
+
+_NODES = {"items": [{"metadata": {"name": "node-a"}},
+                    {"metadata": {"name": "node-b"}}]}
+
+_DS = {"status": {"desiredNumberScheduled": 2, "numberReady": 2}}
+
+
+class _FakeApi(BaseHTTPRequestHandler):
+    requests: list = []
+
+    def do_GET(self):
+        _FakeApi.requests.append((self.path, self.headers.get("Authorization")))
+        if self.path.startswith("/api/v1/pods"):
+            body = _PODS
+        elif self.path.startswith("/api/v1/nodes"):
+            body = _NODES
+        elif "daemonsets" in self.path:
+            body = _DS
+        else:
+            self.send_error(404)
+            return
+        data = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def fake_api():
+    server = HTTPServer(("127.0.0.1", 0), _FakeApi)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    _FakeApi.requests.clear()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_list_pods_nodes_and_rollout(fake_api):
+    client = KubeClient(server=fake_api, token="tok-123")
+    assert client.available()
+    pods = client.list_pods(node_name="node-a")
+    assert pods[0]["metadata"]["name"] == "ig-agent-a"
+    assert client.node_names() == ["node-a", "node-b"]
+    assert client.daemonset_status("ig-tpu", "ig-tpu-agent") == (2, 2)
+    # bearer token attached; node field selector encoded
+    path, auth = _FakeApi.requests[0]
+    assert auth == "Bearer tok-123"
+    assert "fieldSelector=spec.nodeName%3Dnode-a" in path
+
+
+def test_pod_source_adapter_feeds_informer(fake_api):
+    from inspektor_gadget_tpu.containers import (
+        ContainerCollection, with_pod_informer,
+    )
+    client = KubeClient(server=fake_api)
+    cc = ContainerCollection()
+    cc.initialize(with_pod_informer(pod_source_from_client(client),
+                                    interval=30.0))
+    try:
+        got = cc.get_all()
+        assert len(got) == 1
+        c = got[0]
+        assert (c.pod, c.namespace, c.id) == \
+            ("ig-agent-a", "ig-tpu", "deadbeef1234")
+    finally:
+        cc._pod_informer.stop()
+
+
+def test_out_of_cluster_unavailable(monkeypatch):
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    client = KubeClient()
+    assert not client.available()

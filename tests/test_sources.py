@@ -39,6 +39,27 @@ def test_native_synth_generate_columnar():
 
 
 @needs_native
+def test_native_generate_folded_matches_fold64():
+    """The folded fast path emits exactly the xor-fold of the vocab's
+    FNV-64 hashes (the sketch plane's key width) with the same zipf skew."""
+    from inspektor_gadget_tpu.ops import fold64_to_32
+    # small vocab: 100k draws cover every entry on both paths
+    src = NativeCapture(SRC_SYNTH_EXEC, seed=11, vocab=100)
+    fast = src.generate_folded(100_000)
+    assert fast.dtype == np.uint32 and fast.shape == (100_000,)
+    ref = fold64_to_32(src.generate(100_000).cols["key_hash"])
+    assert set(fast.tolist()) == set(ref.tolist())
+    # zipf skew preserved
+    _, counts = np.unique(fast, return_counts=True)
+    assert counts.max() > 100_000 * 0.1
+    # caller buffer reuse path
+    buf = np.zeros(4096, np.uint32)
+    out = src.generate_folded(4096, out=buf)
+    assert out.base is buf or out is buf
+    src.close()
+
+
+@needs_native
 def test_native_vocab_roundtrip():
     src = NativeCapture(SRC_SYNTH_EXEC, seed=1, vocab=100)
     b = src.generate(100)
