@@ -85,7 +85,18 @@ class ExecTunnelDialer:
                 data = conn.recv(65536)
                 if not data:
                     break
-                proc.stdin.write(data)
+                # bufsize=0 → raw FileIO: write() may accept only part of
+                # the chunk when the pipe is full; loop until drained or
+                # the stream corrupts under backpressure
+                mv = memoryview(data)
+                while mv:
+                    n = proc.stdin.write(mv)
+                    if not n:
+                        # would-block/zero write on a blocking pipe: the
+                        # chunk can't be delivered intact — tear the tunnel
+                        # down rather than resume mid-stream corrupted
+                        raise OSError("tunnel stdin short write")
+                    mv = mv[n:]
                 proc.stdin.flush()
         except (OSError, ValueError, BrokenPipeError):
             pass

@@ -112,8 +112,21 @@ def with_oci_config_enrichment(bundle_root: str = ""):
                         cfg.get("mounts", []) if m.get("destination")]
         if not c.env:
             c.env = list(cfg.get("process", {}).get("env", []))
-        for k, v in cfg.get("annotations", {}).items():
+        annotations = cfg.get("annotations", {})
+        for k, v in annotations.items():
             c.labels.setdefault(k, v)
+        # interpret the runtime's annotation dialect into k8s identity so
+        # enrichment works without the k8s API (ref: options.go:628 calls
+        # ociannotations.NewResolverFromAnnotations)
+        from .oci_annotations import resolve_identity
+        ident = resolve_identity(annotations)
+        if ident is not None:
+            if not c.pod:
+                c.pod = ident.pod
+            if not c.namespace:
+                c.namespace = ident.namespace
+            if ident.name and (not c.name or c.name == c.id):
+                c.name = ident.name
         sec = cfg.get("linux", {}).get("seccomp")
         if sec and not c.seccomp_profile:
             c.seccomp_profile = sec.get("defaultAction", "")

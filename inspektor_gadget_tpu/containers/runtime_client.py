@@ -186,17 +186,15 @@ class ContainerdClient:
             except (OSError, ValueError):
                 continue
         annotations = config.get("annotations", {}) if config else {}
-        name = (annotations.get("io.kubernetes.cri.container-name")
-                or annotations.get("io.kubernetes.container.name") or cid[:12])
+        # the dialect key tables live in one place: oci_annotations
+        from .oci_annotations import resolve_identity
+        ident = resolve_identity(annotations)
         return Container(
             id=cid[:12],
-            name=name,
+            name=(ident.name if ident and ident.name else cid[:12]),
             pid=pid,
-            namespace=annotations.get("io.kubernetes.cri.sandbox-namespace",
-                                      annotations.get(
-                                          "io.kubernetes.pod.namespace", "")),
-            pod=annotations.get("io.kubernetes.cri.sandbox-name",
-                                annotations.get("io.kubernetes.pod.name", "")),
+            namespace=ident.namespace if ident else "",
+            pod=ident.pod if ident else "",
             labels=dict(annotations),
             runtime="containerd",
             bundle=bundle,
@@ -207,11 +205,16 @@ class CriGrpcClient:
     """CRI v1 over gRPC — the reference's cri.go mechanism verbatim:
     ListContainers for the running set, then a verbose ContainerStatus per
     container whose info["info"] JSON carries the pid
-    (pkg/container-utils/cri/cri.go:1-295, parseExtraInfo)."""
+    (pkg/container-utils/cri/cri.go:1-295, parseExtraInfo). Like the
+    reference's single long-lived conn, one channel persists for the
+    client's lifetime — a 10-container listing is 11 RPCs on 1 channel,
+    not 11 dials."""
 
     def __init__(self, socket_path: str = ""):
         self.socket_path = socket_path or next(
             (s for s in CRI_SOCKETS if os.path.exists(s)), CRI_SOCKETS[0])
+        self._channel = None
+        self._methods: dict[str, object] = {}
 
     def available(self) -> bool:
         if not os.path.exists(self.socket_path):
@@ -221,20 +224,44 @@ class CriGrpcClient:
         except Exception:  # noqa: BLE001 — any RPC failure means "not CRI"
             return False
 
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+            self._methods = {}
+
+    def __enter__(self) -> "CriGrpcClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def _call(self, method: str, request, response_cls, timeout: float = 5.0):
         import grpc
 
-        from . import cri_pb2  # noqa: F401 — generated stubs
-        channel = grpc.insecure_channel(f"unix://{self.socket_path}")
-        try:
-            fn = channel.unary_unary(
+        fn = self._methods.get(method)
+        if fn is None:
+            if self._channel is None:
+                self._channel = grpc.insecure_channel(
+                    f"unix://{self.socket_path}")
+            fn = self._channel.unary_unary(
                 f"/runtime.v1.RuntimeService/{method}",
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=response_cls.FromString,
             )
+            self._methods[method] = fn
+        try:
             return fn(request, timeout=timeout)
-        finally:
-            channel.close()
+        except grpc.RpcError as e:
+            # drop the channel only on transport-level failure; an
+            # application-level status (NOT_FOUND for a container that
+            # exited between list and status) must not tear down the
+            # shared conn mid-listing
+            code = e.code() if hasattr(e, "code") else None
+            if code in (grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.INTERNAL):
+                self.close()
+            raise
 
     def version(self) -> str:
         from . import cri_pb2
@@ -370,6 +397,11 @@ def detect_runtime_client() -> RuntimeClient | None:
                    CriClient()):
         if client.available():
             return client
+        # rejected probes must not pin resources (CriGrpcClient caches a
+        # channel from its availability RPC)
+        closer = getattr(client, "close", None)
+        if closer is not None:
+            closer()
     return None
 
 

@@ -123,7 +123,10 @@ def _probe_netlink_proc() -> Window:
         s = socket.socket(socket.AF_NETLINK, socket.SOCK_DGRAM,
                           NETLINK_CONNECTOR)
         try:
-            s.bind((os.getpid() & 0x7FFFFFFF, CN_IDX_PROC))
+            # nl_pid 0: kernel auto-assigns a free port — binding the
+            # literal pid collides (EADDRINUSE) when this process already
+            # holds a proc-connector socket (agent with a live exec source)
+            s.bind((0, CN_IDX_PROC))
         finally:
             s.close()
         return Window("netlink_proc", True, "proc connector bind ok")

@@ -90,6 +90,9 @@ class ProfileBlockIo:
                 if len(pending) >= self._FLUSH:
                     sketch = self._fold(sketch, pending)
                     pending = []
+        # release the native handle (and its 64K-slot ring) now — __exit__
+        # only stops the source, keeping it registered until GC
+        src.close()
         if pending:
             sketch = self._fold(sketch, pending)
         out = render_log2_hist(buckets)

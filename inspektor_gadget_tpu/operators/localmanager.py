@@ -106,7 +106,9 @@ class LocalManagerInstance(OperatorInstance):
             # filter only when a container selector is active; a bare local
             # run traces everything including host (ref: localmanager.go
             # host/containername param semantics)
-            if self.selector.name or self.selector.pod or self.selector.namespace:
+            if (self.selector.name or self.selector.pod
+                    or self.selector.namespace
+                    or getattr(self.selector, "labels", None)):
                 self.gadget.set_mntns_filter(
                     op.tc.tracer_mntns_set(self._tracer_id))
         if isinstance(self.gadget, Attacher) and self._attach_enabled():
@@ -150,7 +152,8 @@ class LocalManagerInstance(OperatorInstance):
         if not getattr(self.gadget, "attach_requires_selector", False):
             return True
         return bool(self.selector.name or self.selector.pod
-                    or self.selector.namespace)
+                    or self.selector.namespace
+                    or getattr(self.selector, "labels", None))
 
     def _on_container_event(self, ev) -> None:
         if not self.selector.matches(ev.container):

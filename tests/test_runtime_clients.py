@@ -70,6 +70,7 @@ def test_containerd_client_degrades_without_root(tmp_path):
 
 class _FakeCri:
     def __init__(self):
+        self.calls: dict[str, int] = {}
         self.containers = [
             ("c1" * 16, "web", {"io.kubernetes.pod.name": "pod-a",
                                 "io.kubernetes.pod.namespace": "ns-a"}, 111),
@@ -77,12 +78,14 @@ class _FakeCri:
         ]
 
     def version(self, request: bytes, ctx) -> bytes:
+        self.calls["Version"] = self.calls.get("Version", 0) + 1
         return cri_pb2.VersionResponse(
             version="0.1.0", runtime_name="fake-cri",
             runtime_version="1.0", runtime_api_version="v1",
         ).SerializeToString()
 
     def list_containers(self, request: bytes, ctx) -> bytes:
+        self.calls["ListContainers"] = self.calls.get("ListContainers", 0) + 1
         req = cri_pb2.ListContainersRequest.FromString(request)
         assert req.filter.state.state == cri_pb2.CONTAINER_RUNNING
         resp = cri_pb2.ListContainersResponse()
@@ -96,13 +99,16 @@ class _FakeCri:
         return resp.SerializeToString()
 
     def container_status(self, request: bytes, ctx) -> bytes:
+        self.calls["ContainerStatus"] = self.calls.get("ContainerStatus",
+                                                       0) + 1
         req = cri_pb2.ContainerStatusRequest.FromString(request)
         assert req.verbose
         match = next(((n, l, p) for cid, n, l, p in self.containers
                       if cid == req.container_id), None)
         resp = cri_pb2.ContainerStatusResponse()
         if match is None:
-            return resp.SerializeToString()
+            # real runtimes answer NOT_FOUND for a vanished container
+            ctx.abort(grpc.StatusCode.NOT_FOUND, "no such container")
         name, labels, pid = match
         resp.status.id = req.container_id
         resp.status.metadata.name = name
@@ -113,7 +119,7 @@ class _FakeCri:
 
 
 @pytest.fixture()
-def fake_cri_socket():
+def fake_cri():
     tmp = tempfile.mkdtemp()
     sock = f"{tmp}/cri.sock"
     fake = _FakeCri()
@@ -136,12 +142,13 @@ def fake_cri_socket():
     ))
     server.add_insecure_port(f"unix://{sock}")
     server.start()
-    yield sock
+    yield sock, fake
     server.stop(grace=0.2)
 
 
-def test_cri_grpc_client_lists_with_pids(fake_cri_socket):
-    client = CriGrpcClient(socket_path=fake_cri_socket)
+def test_cri_grpc_client_lists_with_pids(fake_cri):
+    sock, _fake = fake_cri
+    client = CriGrpcClient(socket_path=sock)
     assert client.available()
     assert client.version() == "fake-cri"
     got = {c.name: c for c in client.get_containers()}
@@ -150,6 +157,60 @@ def test_cri_grpc_client_lists_with_pids(fake_cri_socket):
     assert got["web"].pod == "pod-a" and got["web"].namespace == "ns-a"
     assert got["web"].runtime == "cri"
     assert client.get_container("c1" * 16).name == "web"
+    client.close()
+
+
+def test_cri_grpc_client_single_channel_rpc_budget(fake_cri, monkeypatch):
+    """A 10-container listing must cost ONE dial and 1+N RPCs (list +
+    verbose status per container for the pid) — the reference's cri.go
+    holds a single long-lived conn; N+1 channels per list is the bug."""
+    sock, fake = fake_cri
+    fake.containers = [
+        (f"{i:02d}" * 16, f"c{i}", {}, 1000 + i) for i in range(10)
+    ]
+    dials = 0
+    real_dial = grpc.insecure_channel
+
+    def counting_dial(*a, **kw):
+        nonlocal dials
+        dials += 1
+        return real_dial(*a, **kw)
+
+    monkeypatch.setattr(grpc, "insecure_channel", counting_dial)
+    with CriGrpcClient(socket_path=sock) as client:
+        got = client.get_containers()
+    assert len(got) == 10
+    assert {c.name: c.pid for c in got} == {
+        f"c{i}": 1000 + i for i in range(10)}
+    assert dials == 1
+    assert fake.calls["ListContainers"] == 1
+    assert fake.calls["ContainerStatus"] == 10
+
+
+def test_cri_grpc_client_redials_after_transport_error(fake_cri, tmp_path):
+    """A transport-level RpcError (UNAVAILABLE on a dead socket) drops the
+    cached channel; the next call transparently redials."""
+    sock, _fake = fake_cri
+    client = CriGrpcClient(socket_path=str(tmp_path / "dead.sock"))
+    with pytest.raises(grpc.RpcError):
+        client.version()
+    assert client._channel is None  # transport failure dropped the channel
+    client.socket_path = sock
+    assert client.version() == "fake-cri"  # redialed against the live one
+    client.close()
+
+
+def test_cri_grpc_client_keeps_channel_on_not_found(fake_cri):
+    """An application-level status (vanished container mid-listing) must
+    NOT tear down the shared channel."""
+    sock, fake = fake_cri
+    client = CriGrpcClient(socket_path=sock)
+    assert client.version() == "fake-cri"
+    chan = client._channel
+    # unknown id → fake aborts with NOT_FOUND; get_container absorbs it
+    assert client.get_container("ff" * 16) is None
+    assert client._channel is chan  # same channel, no redial
+    client.close()
 
 
 def test_cri_grpc_client_degrades_without_socket(tmp_path):
@@ -222,6 +283,53 @@ def test_oci_config_enrichment(tmp_path):
     assert "MODE=prod" in got.env
     assert got.labels["org.opencontainers.image.ref.name"] == "img:1"
     assert got.seccomp_profile == "SCMP_ACT_ERRNO"
+
+
+def test_oci_annotation_dialects_resolve_identity(tmp_path):
+    """Both runtime annotation dialects map to pod/namespace/container
+    identity with no k8s API (ref: oci-annotations resolver_containerd.go,
+    resolver_crio.go)."""
+    cases = {
+        "cd1": {  # containerd dialect
+            "io.kubernetes.cri.sandbox-name": "pod-cd",
+            "io.kubernetes.cri.sandbox-namespace": "ns-cd",
+            "io.kubernetes.cri.sandbox-uid": "uid-cd",
+            "io.kubernetes.cri.container-name": "app-cd",
+            "io.kubernetes.cri.container-type": "container",
+        },
+        "cr1": {  # cri-o dialect
+            "io.container.manager": "cri-o",
+            "io.kubernetes.pod.name": "pod-cr",
+            "io.kubernetes.pod.namespace": "ns-cr",
+            "io.kubernetes.pod.uid": "uid-cr",
+            "io.kubernetes.container.name": "app-cr",
+            "io.kubernetes.cri-o.ContainerType": "container",
+        },
+    }
+    for cid, annotations in cases.items():
+        bundle = tmp_path / cid
+        bundle.mkdir()
+        (bundle / "config.json").write_text(
+            json.dumps({"annotations": annotations}))
+    cc = ContainerCollection()
+    cc.initialize(with_oci_config_enrichment(bundle_root=str(tmp_path)))
+    cc.add_container(Container(id="cd1", pid=os.getpid()))
+    cc.add_container(Container(id="cr1", pid=os.getpid()))
+    cd = cc.get("cd1")
+    assert (cd.pod, cd.namespace, cd.name) == ("pod-cd", "ns-cd", "app-cd")
+    cr = cc.get("cr1")
+    assert (cr.pod, cr.namespace, cr.name) == ("pod-cr", "ns-cr", "app-cr")
+
+
+def test_oci_annotation_resolver_unknown_dialect():
+    from inspektor_gadget_tpu.containers.oci_annotations import (
+        resolve_identity, resolver_for,
+    )
+    assert resolve_identity({"unrelated": "x"}) is None
+    assert resolver_for("docker") is None
+    ident = resolver_for("containerd").resolve(
+        {"io.kubernetes.cri.sandbox-name": "p"})
+    assert ident.pod == "p" and ident.runtime == "containerd"
 
 
 def test_with_host_adds_host_pseudo_container():
