@@ -100,6 +100,24 @@ def resolver_from_annotations(
 
 def resolve_identity(
         annotations: dict[str, str]) -> ResolvedIdentity | None:
-    """One-shot: detect dialect and resolve, None if neither dialect."""
+    """One-shot: detect dialect and resolve, None if neither dialect.
+
+    Falls back per-field to the other dialect: real bundles mix key sets
+    (containerd-prefixed sandbox keys alongside kubelet container-name
+    labels), so locking every field to the detected dialect would drop
+    identity the annotations actually carry.
+    """
     r = resolver_from_annotations(annotations)
-    return r.resolve(annotations) if r else None
+    if r is None:
+        return None
+    primary = r.resolve(annotations)
+    other = _RESOLVERS["cri-o" if r.runtime == "containerd" else "containerd"]
+    fallback = other.resolve(annotations)
+    return ResolvedIdentity(
+        runtime=primary.runtime,
+        name=primary.name or fallback.name,
+        pod=primary.pod or fallback.pod,
+        namespace=primary.namespace or fallback.namespace,
+        pod_uid=primary.pod_uid or fallback.pod_uid,
+        container_type=primary.container_type or fallback.container_type,
+    )

@@ -144,6 +144,18 @@ def _probe_af_packet() -> Window:
                       f"AF_PACKET: {e.strerror} (needs CAP_NET_RAW)")
 
 
+def _probe_tcpinfo() -> Window:
+    # top/tcp byte counters: sock_diag ext INET_DIAG_INFO (kernel >= 4.1)
+    try:
+        from .sources.bridge import tcpinfo_supported
+        ok = tcpinfo_supported()
+        return Window("tcpinfo", ok,
+                      "sock_diag INET_DIAG_INFO byte counters ok" if ok else
+                      "INET_DIAG_INFO dump failed (kernel < 4.1?)")
+    except Exception as e:  # noqa: BLE001
+        return Window("tcpinfo", False, repr(e))
+
+
 def _probe_blktrace() -> Window:
     try:
         from .sources.bridge import blktrace_supported
@@ -177,7 +189,7 @@ def _probe_procfs() -> Window:
 _PROBES = (
     _probe_native_lib, _probe_fanotify, _probe_perf, _probe_kmsg,
     _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
-    _probe_mountinfo, _probe_procfs, _probe_blktrace,
+    _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
 )
 
 
@@ -232,7 +244,9 @@ _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
     ("profile", "block-io"): ("blktrace", "procfs",
                               "per-IO tracefs latency; diskstats fallback"),
     ("top", "file"): ("procfs", "", "/proc/<pid>/io deltas"),
-    ("top", "tcp"): ("procfs", "", "/proc/net drains"),
+    ("top", "tcp"): ("tcpinfo", "procfs",
+                     "per-connection INET_DIAG_INFO byte deltas; "
+                     "connection-churn fallback"),
     ("top", "block-io"): ("procfs", "", "/proc/diskstats deltas"),
     ("top", "sketch"): ("native_lib", "", "capture-plane self-observation"),
     ("top", "self"): ("native_lib", "", "native source self-stats"),

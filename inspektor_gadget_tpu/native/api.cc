@@ -53,6 +53,7 @@ enum {
   IG_SRC_FANOTIFY_RUNC = 109,
   IG_SRC_PERF_CPU = 110,
   IG_SRC_BLK_TRACE = 111,
+  IG_SRC_TCP_BYTES = 112,
   IG_SRC_PKT_DNS = 200,
   IG_SRC_PKT_SNI = 201,
   IG_SRC_PKT_FLOW = 202,
@@ -152,6 +153,9 @@ uint64_t ig_source_create_cfg(uint32_t kind, const char* cfg,
     case IG_SRC_BLK_TRACE:
       s = new BlkTraceSource(cap, c);
       break;
+    case IG_SRC_TCP_BYTES:
+      s = new TcpBytesSource(cap, c);
+      break;
     default:
       return 0;
   }
@@ -235,6 +239,15 @@ int ig_perf_supported() {
 int ig_blktrace_supported() {
 #ifdef __linux__
   return BlkTraceSource::supported() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// Per-connection TCP byte counters available? (sock_diag INET_DIAG_INFO)
+int ig_tcpinfo_supported() {
+#ifdef __linux__
+  return TcpBytesSource::supported() ? 1 : 0;
 #else
   return 0;
 #endif

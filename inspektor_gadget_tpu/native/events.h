@@ -36,6 +36,8 @@ enum EventKind : uint32_t {
   EV_SYSCALL = 18,  // traceloop/seccomp-style raw syscall stream
   EV_PERF_SAMPLE = 19,  // CPU sampling profiler hit (profile/cpu)
   EV_CONTAINER = 20,    // container lifecycle from the runc fanotify watch
+  EV_TCP_BYTES = 21,    // per-connection interval byte deltas (top/tcp)
+  EV_AUDIT = 22,        // kernel audit record (host-wide capability/seccomp)
 };
 
 // 64-byte POD slot; layout is the ring-buffer ABI shared with Python.

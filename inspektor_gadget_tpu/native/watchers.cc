@@ -29,7 +29,9 @@
 #include <dirent.h>
 #include <linux/inet_diag.h>
 #include <linux/netlink.h>
+#include <linux/rtnetlink.h>
 #include <linux/sock_diag.h>
+#include <linux/tcp.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
 
@@ -223,6 +225,41 @@ class MountInfoSource : public Source {
   }
 };
 
+// One /proc pass resolving socket inodes to owning pids (shared by the
+// sock_diag sources; the reference gets pid identity in-kernel from the
+// calling task, a luxury the netlink window lacks).
+inline void resolve_socket_inodes(const std::vector<uint64_t>& inodes,
+                                  std::unordered_map<uint64_t, uint32_t>& owner) {
+  std::unordered_set<uint64_t> want(inodes.begin(), inodes.end());
+  DIR* proc = opendir("/proc");
+  if (!proc) return;
+  struct dirent* de;
+  while ((de = readdir(proc)) && !want.empty()) {
+    char* end;
+    unsigned long pid = strtoul(de->d_name, &end, 10);
+    if (*end || !pid) continue;
+    char fdpath[64];
+    snprintf(fdpath, sizeof(fdpath), "/proc/%lu/fd", pid);
+    DIR* fds = opendir(fdpath);
+    if (!fds) continue;
+    struct dirent* fd;
+    while ((fd = readdir(fds))) {
+      char link[384], target[64];
+      snprintf(link, sizeof(link), "%s/%s", fdpath, fd->d_name);
+      ssize_t n = readlink(link, target, sizeof(target) - 1);
+      if (n <= 9 || strncmp(target, "socket:[", 8) != 0) continue;
+      target[n] = 0;
+      uint64_t inode = strtoull(target + 8, nullptr, 10);
+      if (want.count(inode)) {
+        owner[inode] = (uint32_t)pid;
+        want.erase(inode);
+      }
+    }
+    closedir(fds);
+  }
+  closedir(proc);
+}
+
 // ---------------------------------------------------------------------------
 // SockDiagBindSource — trace/bind via NETLINK_SOCK_DIAG dumps.
 // ---------------------------------------------------------------------------
@@ -384,37 +421,298 @@ class SockDiagBindSource : public Source {
 
   void resolve_inodes(const std::vector<uint64_t>& inodes,
                       std::unordered_map<uint64_t, uint32_t>& owner) {
-    std::unordered_set<uint64_t> want(inodes.begin(), inodes.end());
-    DIR* proc = opendir("/proc");
-    if (!proc) return;
-    struct dirent* de;
-    while ((de = readdir(proc)) && !want.empty()) {
-      char* end;
-      unsigned long pid = strtoul(de->d_name, &end, 10);
-      if (*end || !pid) continue;
-      char fdpath[64];
-      snprintf(fdpath, sizeof(fdpath), "/proc/%lu/fd", pid);
-      DIR* fds = opendir(fdpath);
-      if (!fds) continue;
-      struct dirent* fd;
-      while ((fd = readdir(fds))) {
-        char link[384], target[64];
-        snprintf(link, sizeof(link), "%s/%s", fdpath, fd->d_name);
-        ssize_t n = readlink(link, target, sizeof(target) - 1);
-        if (n <= 9 || strncmp(target, "socket:[", 8) != 0) continue;
-        target[n] = 0;
-        uint64_t inode = strtoull(target + 8, nullptr, 10);
-        if (want.count(inode)) {
-          owner[inode] = (uint32_t)pid;
-          want.erase(inode);
-        }
-      }
-      closedir(fds);
-    }
-    closedir(proc);
+    resolve_socket_inodes(inodes, owner);
   }
 
   int interval_ms_;
+};
+
+// ---------------------------------------------------------------------------
+// TcpBytesSource — top/tcp via sock_diag INET_DIAG_INFO byte counters.
+//
+// The reference's tcptop.bpf.c (1-133) kprobes tcp_sendmsg/tcp_cleanup_rbuf
+// and sums bytes per connection in a BPF map drained each interval
+// (tracer.go:222-314). The kernel exports the same per-socket totals with
+// no probes: sock_diag with ext INET_DIAG_INFO returns struct tcp_info per
+// socket, whose tcpi_bytes_acked (RFC4898 tcpEStatsAppHCThruOctetsAcked ≈
+// bytes sent and acked) and tcpi_bytes_received are cumulative since
+// connection start (kernel >= 4.1). Dumping every interval and diffing per
+// socket inode yields real SENT/RECV deltas per connection. Events:
+//   key_hash  "saddr:sport->daddr:dport" (vocab)   kind EV_TCP_BYTES
+//   aux1 sent-bytes delta     aux2 recv-bytes delta
+//   pid/comm/mntns  socket owner, resolved once per socket via /proc
+// Sockets that existed before the first dump contribute deltas only (their
+// pre-existing totals are the baseline); sockets born later contribute
+// everything — i.e. bytes are counted "since gadget start", the reference's
+// semantics.
+// ---------------------------------------------------------------------------
+
+class TcpBytesSource : public Source {
+ public:
+  TcpBytesSource(size_t ring_pow2, const std::string& cfg)
+      : Source(ring_pow2) {
+    interval_ms_ = atoi(cfg_get(cfg, "interval_ms", "500").c_str());
+    if (interval_ms_ <= 0) interval_ms_ = 500;
+  }
+  ~TcpBytesSource() override { stop(); }
+
+  // The window exists only when a dumped socket actually carries the byte
+  // counters: a dump can answer fine on kernels whose tcp_info is shorter
+  // than tcpi_bytes_received (< 4.1), and then the source would emit
+  // nothing forever while claiming to be real. A loopback listen socket
+  // guarantees at least one dumpable socket to length-check even on an
+  // otherwise idle host.
+  static bool supported() {
+    int probe = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (probe >= 0) {
+      struct sockaddr_in a{};
+      a.sin_family = AF_INET;
+      a.sin_addr.s_addr = htonl(0x7f000001);
+      if (bind(probe, (struct sockaddr*)&a, sizeof(a)) != 0 ||
+          listen(probe, 1) != 0) {
+        close(probe);
+        probe = -1;
+      }
+    }
+    int sd = socket(AF_NETLINK, SOCK_RAW | SOCK_CLOEXEC, NETLINK_SOCK_DIAG);
+    if (sd < 0) {
+      if (probe >= 0) close(probe);
+      return false;
+    }
+    struct {
+      struct nlmsghdr nlh;
+      struct inet_diag_req_v2 req;
+    } r{};
+    r.nlh.nlmsg_len = sizeof(r);
+    r.nlh.nlmsg_type = SOCK_DIAG_BY_FAMILY;
+    r.nlh.nlmsg_flags = NLM_F_REQUEST | NLM_F_DUMP;
+    r.req.sdiag_family = AF_INET;
+    r.req.sdiag_protocol = IPPROTO_TCP;
+    r.req.idiag_states = 0xffffffff;
+    r.req.idiag_ext = 1u << (INET_DIAG_INFO - 1);
+    bool ok = false;
+    if (send(sd, &r, sizeof(r), 0) == (ssize_t)sizeof(r)) {
+      char buf[65536];
+      bool done = false;
+      while (!done) {
+        ssize_t len = recv(sd, buf, sizeof(buf), 0);
+        if (len <= 0) break;
+        for (struct nlmsghdr* h = (struct nlmsghdr*)buf;
+             NLMSG_OK(h, (size_t)len); h = NLMSG_NEXT(h, len)) {
+          if (h->nlmsg_type == NLMSG_DONE || h->nlmsg_type == NLMSG_ERROR) {
+            done = true;
+            break;
+          }
+          auto* msg = (struct inet_diag_msg*)NLMSG_DATA(h);
+          int rem = (int)(h->nlmsg_len - NLMSG_LENGTH(sizeof(*msg)));
+          auto* rta =
+              (struct rtattr*)((char*)msg + NLMSG_ALIGN(sizeof(*msg)));
+          for (; RTA_OK(rta, rem); rta = RTA_NEXT(rta, rem)) {
+            if (rta->rta_type == INET_DIAG_INFO &&
+                RTA_PAYLOAD(rta) >=
+                    offsetof(struct tcp_info, tcpi_bytes_received) +
+                        sizeof(uint64_t))
+              ok = true;
+          }
+        }
+      }
+    }
+    close(sd);
+    if (probe >= 0) close(probe);
+    return ok;
+  }
+
+ protected:
+  struct ConnState {
+    uint64_t acked = 0, received = 0;
+    uint64_t conn_hash = 0;
+    uint32_t pid = 0;
+    uint8_t family = 0;
+    bool seen = false;  // present in the current scan
+  };
+
+  void run() override {
+    bool first = true;
+    while (running_.load(std::memory_order_relaxed)) {
+      for (auto& [inode, c] : conns_) c.seen = false;
+      std::vector<uint64_t> fresh;
+      bool v4_ok = dump_family(AF_INET, first, fresh);
+      bool v6_ok = dump_family(AF_INET6, first, fresh);
+      if (!fresh.empty()) {
+        std::unordered_map<uint64_t, uint32_t> owner;
+        resolve_socket_inodes(fresh, owner);
+        for (uint64_t ino : fresh) {
+          auto it = owner.find(ino);
+          if (it != owner.end()) conns_[ino].pid = it->second;
+        }
+        // newborn sockets' whole history belongs to this window: emit it
+        // now that the pid is known (deltas were parked in pending_)
+        for (auto& [ino, delta] : pending_) {
+          auto ct = conns_.find(ino);
+          if (ct != conns_.end())
+            push(ct->second, delta.first, delta.second);
+        }
+      }
+      pending_.clear();
+      // Closed sockets disappear from the dump; drop their state — but
+      // only for families whose dump ran to NLMSG_DONE. A transiently
+      // failed dump (fd exhaustion, ENOBUFS) must keep state: erasing
+      // would make every live connection look newborn next tick and
+      // re-emit its whole cumulative history as one interval's delta.
+      // Per-family so a host whose v6 dump always errors still reaps v4.
+      for (auto it = conns_.begin(); it != conns_.end();) {
+        bool dumped = it->second.family == AF_INET6 ? v6_ok : v4_ok;
+        it = (!it->second.seen && dumped) ? conns_.erase(it) : std::next(it);
+      }
+      first = false;
+      int waited = 0;
+      while (waited < interval_ms_ &&
+             running_.load(std::memory_order_relaxed)) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(20));
+        waited += 20;
+      }
+    }
+  }
+
+ private:
+  // Returns true only when the dump ran to NLMSG_DONE (a partial or failed
+  // dump must not be mistaken for "those sockets closed").
+  bool dump_family(uint8_t family, bool first, std::vector<uint64_t>& fresh) {
+    int sd = socket(AF_NETLINK, SOCK_RAW | SOCK_CLOEXEC, NETLINK_SOCK_DIAG);
+    if (sd < 0) return false;
+    struct {
+      struct nlmsghdr nlh;
+      struct inet_diag_req_v2 req;
+    } r{};
+    r.nlh.nlmsg_len = sizeof(r);
+    r.nlh.nlmsg_type = SOCK_DIAG_BY_FAMILY;
+    r.nlh.nlmsg_flags = NLM_F_REQUEST | NLM_F_DUMP;
+    r.req.sdiag_family = family;
+    r.req.sdiag_protocol = IPPROTO_TCP;
+    r.req.idiag_states = 0xffffffff;  // every state; LISTEN skipped in parse
+    r.req.idiag_ext = 1u << (INET_DIAG_INFO - 1);
+    if (send(sd, &r, sizeof(r), 0) < 0) {
+      close(sd);
+      return false;
+    }
+    char buf[65536];
+    bool done = false, clean = false;
+    while (!done) {
+      ssize_t len = recv(sd, buf, sizeof(buf), 0);
+      if (len <= 0) break;
+      for (struct nlmsghdr* h = (struct nlmsghdr*)buf; NLMSG_OK(h, (size_t)len);
+           h = NLMSG_NEXT(h, len)) {
+        if (h->nlmsg_type == NLMSG_DONE || h->nlmsg_type == NLMSG_ERROR) {
+          done = true;
+          clean = h->nlmsg_type == NLMSG_DONE;
+          break;
+        }
+        parse_sock(h, family, first, fresh);
+      }
+    }
+    close(sd);
+    return clean;
+  }
+
+  void parse_sock(struct nlmsghdr* h, uint8_t family, bool first,
+                  std::vector<uint64_t>& fresh) {
+    auto* msg = (struct inet_diag_msg*)NLMSG_DATA(h);
+    if (msg->idiag_state == 10 /*TCP_LISTEN*/ || msg->idiag_inode == 0)
+      return;
+    // walk the attribute list for INET_DIAG_INFO (struct tcp_info; may be
+    // truncated on old kernels — require the byte counters to be present)
+    int rem = (int)(h->nlmsg_len - NLMSG_LENGTH(sizeof(*msg)));
+    auto* rta = (struct rtattr*)((char*)msg + NLMSG_ALIGN(sizeof(*msg)));
+    const struct tcp_info* ti = nullptr;
+    for (; RTA_OK(rta, rem); rta = RTA_NEXT(rta, rem)) {
+      if (rta->rta_type == INET_DIAG_INFO &&
+          RTA_PAYLOAD(rta) >= offsetof(struct tcp_info, tcpi_bytes_received) +
+                                  sizeof(uint64_t)) {
+        ti = (const struct tcp_info*)RTA_DATA(rta);
+        break;
+      }
+    }
+    if (!ti) return;
+    uint64_t inode = msg->idiag_inode;
+    auto it = conns_.find(inode);
+    if (it == conns_.end()) {
+      ConnState c;
+      c.conn_hash = put_conn_key(msg, family);
+      c.family = family;
+      c.seen = true;
+      if (first) {
+        // pre-existing connection: its history is the baseline, but the
+        // owner still needs resolving for later deltas
+        fresh.push_back(inode);
+        c.acked = ti->tcpi_bytes_acked;
+        c.received = ti->tcpi_bytes_received;
+      } else {
+        // born inside the window: everything counts; emit after the pid
+        // resolve pass (one /proc scan for all newborns, not one each)
+        fresh.push_back(inode);
+        if (ti->tcpi_bytes_acked || ti->tcpi_bytes_received)
+          pending_[inode] = {ti->tcpi_bytes_acked, ti->tcpi_bytes_received};
+        c.acked = ti->tcpi_bytes_acked;
+        c.received = ti->tcpi_bytes_received;
+      }
+      conns_.emplace(inode, c);
+      return;
+    }
+    ConnState& c = it->second;
+    c.seen = true;
+    uint64_t ds = ti->tcpi_bytes_acked >= c.acked
+                      ? ti->tcpi_bytes_acked - c.acked : 0;
+    uint64_t dr = ti->tcpi_bytes_received >= c.received
+                      ? ti->tcpi_bytes_received - c.received : 0;
+    c.acked = ti->tcpi_bytes_acked;
+    c.received = ti->tcpi_bytes_received;
+    if (ds || dr) push(c, ds, dr);
+  }
+
+  uint64_t put_conn_key(const struct inet_diag_msg* msg, uint8_t family) {
+    char key[128];
+    int kn;
+    uint16_t sport = ntohs(msg->id.idiag_sport);
+    uint16_t dport = ntohs(msg->id.idiag_dport);
+    if (family == AF_INET) {
+      uint32_t s = ntohl(msg->id.idiag_src[0]);
+      uint32_t d = ntohl(msg->id.idiag_dst[0]);
+      kn = snprintf(key, sizeof(key), "%u.%u.%u.%u:%u->%u.%u.%u.%u:%u",
+                    s >> 24, (s >> 16) & 0xff, (s >> 8) & 0xff, s & 0xff,
+                    sport, d >> 24, (d >> 16) & 0xff, (d >> 8) & 0xff,
+                    d & 0xff, dport);
+    } else {
+      kn = snprintf(key, sizeof(key),
+                    "[%08x:%08x:%08x:%08x]:%u->[%08x:%08x:%08x:%08x]:%u",
+                    ntohl(msg->id.idiag_src[0]), ntohl(msg->id.idiag_src[1]),
+                    ntohl(msg->id.idiag_src[2]), ntohl(msg->id.idiag_src[3]),
+                    sport,
+                    ntohl(msg->id.idiag_dst[0]), ntohl(msg->id.idiag_dst[1]),
+                    ntohl(msg->id.idiag_dst[2]), ntohl(msg->id.idiag_dst[3]),
+                    dport);
+    }
+    uint64_t h = fnv1a64(key, (size_t)kn);
+    vocab_.put(h, key, (size_t)kn);
+    return h;
+  }
+
+  void push(const ConnState& c, uint64_t sent, uint64_t received) {
+    Event ev{};
+    ev.ts_ns = now_ns();
+    ev.kind = EV_TCP_BYTES;
+    ev.aux1 = sent;
+    ev.aux2 = received;
+    if (c.pid) {
+      ev.pid = c.pid;
+      fill_proc_identity(ev, vocab_, c.pid);
+    }
+    ev.key_hash = c.conn_hash;  // after identity fill: the conn is the key
+    emit(ev);
+  }
+
+  int interval_ms_;
+  std::unordered_map<uint64_t, ConnState> conns_;
+  std::unordered_map<uint64_t, std::pair<uint64_t, uint64_t>> pending_;
 };
 
 // ---------------------------------------------------------------------------

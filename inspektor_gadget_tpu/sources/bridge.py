@@ -34,13 +34,15 @@ SRC_PTRACE = 108
 SRC_FANOTIFY_RUNC = 109
 SRC_PERF_CPU = 110
 SRC_BLK_TRACE = 111
+SRC_TCP_BYTES = 112
 SRC_PKT_DNS = 200
 SRC_PKT_SNI = 201
 SRC_PKT_FLOW = 202
 
 # kinds that take a "key=value\x1f..." config string (create_cfg path)
 _CFG_KINDS = {SRC_FANOTIFY_OPEN, SRC_MOUNTINFO, SRC_SOCK_DIAG, SRC_KMSG_OOM,
-              SRC_PTRACE, SRC_FANOTIFY_RUNC, SRC_PERF_CPU, SRC_BLK_TRACE}
+              SRC_PTRACE, SRC_FANOTIFY_RUNC, SRC_PERF_CPU, SRC_BLK_TRACE,
+              SRC_TCP_BYTES}
 
 
 def make_cfg(**kw) -> str:
@@ -110,6 +112,8 @@ def _load_and_bind(rebuild: bool):
     lib.ig_perf_supported.restype = ctypes.c_int
     lib.ig_blktrace_supported.argtypes = []
     lib.ig_blktrace_supported.restype = ctypes.c_int
+    lib.ig_tcpinfo_supported.argtypes = []
+    lib.ig_tcpinfo_supported.restype = ctypes.c_int
     for fn in ("ig_source_start", "ig_source_stop", "ig_source_destroy"):
         getattr(lib, fn).argtypes = [u64]
         getattr(lib, fn).restype = ctypes.c_int
@@ -172,6 +176,12 @@ def blktrace_supported() -> bool:
     return lib is not None and bool(lib.ig_blktrace_supported())
 
 
+def tcpinfo_supported() -> bool:
+    """Per-connection TCP byte counters (sock_diag INET_DIAG_INFO)."""
+    lib = _load()
+    return lib is not None and bool(lib.ig_tcpinfo_supported())
+
+
 _SRC_KIND_NAMES = {
     SRC_SYNTH_EXEC: "synth/exec", SRC_SYNTH_TCP: "synth/tcp",
     SRC_SYNTH_DNS: "synth/dns", SRC_PROC_EXEC: "netlink/proc",
@@ -180,7 +190,7 @@ _SRC_KIND_NAMES = {
     SRC_SOCK_DIAG: "sock_diag", SRC_KMSG_OOM: "kmsg/oom",
     SRC_PTRACE: "ptrace", SRC_FANOTIFY_RUNC: "fanotify/runc",
     SRC_PERF_CPU: "perf/cpu", SRC_BLK_TRACE: "blk/trace",
-    SRC_PKT_DNS: "pkt/dns",
+    SRC_TCP_BYTES: "sock_diag/tcpinfo", SRC_PKT_DNS: "pkt/dns",
     SRC_PKT_SNI: "pkt/sni", SRC_PKT_FLOW: "pkt/flow",
 }
 

@@ -29,7 +29,7 @@ def test_probe_windows_names_and_shape():
     windows = probe_windows()
     expected = {"native_lib", "fanotify", "perf", "kmsg", "ptrace",
                 "sock_diag", "netlink_proc", "af_packet", "mountinfo",
-                "procfs", "blktrace"}
+                "procfs", "blktrace", "tcpinfo"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail

@@ -321,6 +321,30 @@ def test_oci_annotation_dialects_resolve_identity(tmp_path):
     assert (cr.pod, cr.namespace, cr.name) == ("pod-cr", "ns-cr", "app-cr")
 
 
+def test_oci_annotation_mixed_dialect_falls_back_per_field():
+    """Real bundles mix dialects (containerd sandbox keys + kubelet
+    container-name label); each field falls back to the other dialect
+    instead of returning empty."""
+    from inspektor_gadget_tpu.containers.oci_annotations import (
+        resolve_identity,
+    )
+    ident = resolve_identity({
+        "io.kubernetes.cri.sandbox-namespace": "ns-mixed",
+        "io.kubernetes.container.name": "app-mixed",  # kubelet key only
+    })
+    assert ident is not None and ident.runtime == "containerd"
+    assert ident.namespace == "ns-mixed"
+    assert ident.name == "app-mixed"
+    # mirror case: cri-o detected, pod name only under the containerd key
+    ident2 = resolve_identity({
+        "io.container.manager": "cri-o",
+        "io.kubernetes.pod.namespace": "ns2",
+        "io.kubernetes.cri.sandbox-name": "pod2",
+    })
+    assert ident2 is not None and ident2.runtime == "cri-o"
+    assert (ident2.namespace, ident2.pod) == ("ns2", "pod2")
+
+
 def test_oci_annotation_resolver_unknown_dialect():
     from inspektor_gadget_tpu.containers.oci_annotations import (
         resolve_identity, resolver_for,
