@@ -243,7 +243,9 @@ _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
                          "49Hz callchains; procfs stat-delta fallback"),
     ("profile", "block-io"): ("blktrace", "procfs",
                               "per-IO tracefs latency; diskstats fallback"),
-    ("top", "file"): ("procfs", "", "/proc/<pid>/io deltas"),
+    ("top", "file"): ("fanotify", "procfs",
+                      "per-(pid,file) fanotify rows with filenames; "
+                      "per-process /proc/<pid>/io fallback"),
     ("top", "tcp"): ("tcpinfo", "procfs",
                      "per-connection INET_DIAG_INFO byte deltas; "
                      "connection-churn fallback"),

@@ -51,9 +51,16 @@ class _TcpFeed(SourceTraceGadget):
 
     def __init__(self, ctx, interval_s: float = 1.0):
         super().__init__(ctx)
-        # prefer the byte-accurate window; fall back to connection churn
-        self._bytes_mode = native_available() and tcpinfo_supported()
-        self.native_kind = SRC_TCP_BYTES if self._bytes_mode else SRC_PROC_TCP
+        # An explicit synthetic run must not probe (or claim) the real
+        # window — fabricated data stays labeled as such.
+        if self._mode in ("synthetic", "pysynthetic"):
+            self._bytes_mode = False
+            self.native_kind = SRC_PROC_TCP
+        else:
+            # prefer the byte-accurate window; fall back to connection churn
+            self._bytes_mode = native_available() and tcpinfo_supported()
+            self.native_kind = (SRC_TCP_BYTES if self._bytes_mode
+                                else SRC_PROC_TCP)
         # poll at half the drain interval (bounded) so each drain sees at
         # least one fresh delta per active connection
         self._poll_ms = max(100, min(int(interval_s * 500), 1000))
@@ -81,7 +88,9 @@ class TopTcp(IntervalGadget):
         self._feed.set_mntns_filter(mntns_ids)
 
     def setup(self, ctx) -> None:
-        if self._feed.bytes_mode:
+        if self._feed._mode in ("synthetic", "pysynthetic"):
+            ctx.logger.info("top/tcp: SYNTHETIC source — fabricated rows")
+        elif self._feed.bytes_mode:
             ctx.logger.info("top/tcp: sock_diag INET_DIAG_INFO window "
                             "(real per-connection byte counters)")
         else:
@@ -112,8 +121,10 @@ class TopTcp(IntervalGadget):
                 if int(c["kind"][i]) == EV_TCP_BYTES:
                     ent[1] += int(c["aux1"][i])
                     ent[2] += int(c["aux2"][i])
-                else:
-                    # synthetic/churn flavour: aux1 low bits fabricate bytes
+                elif not self._feed._is_native:
+                    # synthetic flavour only: aux1 low bits fabricate bytes.
+                    # The native churn fallback's aux1 is an address hash —
+                    # never presented as bytes (SENT/RECV stay 0 there).
                     ent[1] += int(c["aux1"][i]) & 0xFFFF
 
     def collect(self, ctx) -> list[TcpTopStats]:

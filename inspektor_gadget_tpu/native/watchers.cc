@@ -444,7 +444,10 @@ class SockDiagBindSource : public Source {
 // Sockets that existed before the first dump contribute deltas only (their
 // pre-existing totals are the baseline); sockets born later contribute
 // everything — i.e. bytes are counted "since gadget start", the reference's
-// semantics.
+// semantics. Two limits vs the kprobe window, both documented to users:
+// a connection opening AND closing within one poll tick is never seen, and
+// the dump is scoped to this process's network namespace (kprobes are
+// system-wide) — containers with private netns need the per-netns path.
 // ---------------------------------------------------------------------------
 
 class TcpBytesSource : public Source {

@@ -182,6 +182,12 @@ def tcpinfo_supported() -> bool:
     return lib is not None and bool(lib.ig_tcpinfo_supported())
 
 
+def fanotify_supported() -> bool:
+    """fanotify mount marks available (needs CAP_SYS_ADMIN)."""
+    lib = _load()
+    return lib is not None and bool(lib.ig_fanotify_supported())
+
+
 _SRC_KIND_NAMES = {
     SRC_SYNTH_EXEC: "synth/exec", SRC_SYNTH_TCP: "synth/tcp",
     SRC_SYNTH_DNS: "synth/dns", SRC_PROC_EXEC: "netlink/proc",
