@@ -144,6 +144,19 @@ def _probe_af_packet() -> Window:
                       f"AF_PACKET: {e.strerror} (needs CAP_NET_RAW)")
 
 
+def _probe_audit() -> Window:
+    # host-wide audit window: NETLINK_AUDIT + READLOG multicast
+    # (CAP_AUDIT_READ; kernel >= 3.16)
+    try:
+        from .sources.bridge import audit_supported
+        ok = audit_supported()
+        return Window("audit", ok,
+                      "NETLINK_AUDIT readlog multicast ok" if ok else
+                      "audit readlog unavailable (needs CAP_AUDIT_READ)")
+    except Exception as e:  # noqa: BLE001
+        return Window("audit", False, repr(e))
+
+
 def _probe_tcpinfo() -> Window:
     # top/tcp byte counters: sock_diag ext INET_DIAG_INFO (kernel >= 4.1)
     try:
@@ -190,6 +203,7 @@ _PROBES = (
     _probe_native_lib, _probe_fanotify, _probe_perf, _probe_kmsg,
     _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
+    _probe_audit,
 )
 
 
@@ -256,6 +270,15 @@ _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
     ("snapshot", "socket"): ("procfs", "", "procfs collector"),
     ("advise", "network-policy"): ("af_packet", "",
                                    "synthesizes from trace/network events"),
+    # host-wide audit windows with the ptrace per-target flavour as the
+    # labeled fallback (ref: capable.bpf.c / audit-seccomp.bpf.c are
+    # system-wide kprobes)
+    ("trace", "capabilities"): ("audit", "ptrace",
+                                "host-wide EPERM/EACCES denial records; "
+                                "ptrace per-target flavour also sees allows"),
+    ("audit", "seccomp"): ("audit", "ptrace",
+                           "host-wide AUDIT_SECCOMP records; ptrace "
+                           "per-target flavour also sees RET_ERRNO"),
 }
 
 
@@ -276,9 +299,17 @@ def gadget_report(windows: dict[str, Window] | None = None) -> list[GadgetStatus
         # class attribute off a probe instance when cheap, else the class
         g_cls = _gadget_class(desc)
         native_kind = getattr(g_cls, "native_kind", None) if g_cls else None
-        if native_kind is None and (desc.category, desc.name) in _GADGET_WINDOWS:
+        # the explicit table wins over the class source kind: gadgets that
+        # pick their window at runtime (audit vs ptrace) declare both here
+        if (desc.category, desc.name) in _GADGET_WINDOWS:
             window, fallback, note = _GADGET_WINDOWS[desc.category, desc.name]
-            if windows.get(window) and windows[window].ok:
+            if native_kind is not None and not native_ok:
+                # both flavours run through the capture library; a probe-ok
+                # kernel window doesn't help if the lib can't load
+                out.append(GadgetStatus(desc.category, desc.name,
+                                        "unavailable", window,
+                                        windows["native_lib"].detail))
+            elif windows.get(window) and windows[window].ok:
                 out.append(GadgetStatus(desc.category, desc.name, "real",
                                         window, note))
             elif fallback and windows.get(fallback) and windows[fallback].ok:

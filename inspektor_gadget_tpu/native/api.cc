@@ -18,6 +18,7 @@
 #include "fanotify.cc"
 #include "ptrace_source.cc"
 #include "perf_sampler.cc"
+#include "audit_source.cc"
 
 using namespace ig;
 
@@ -54,6 +55,7 @@ enum {
   IG_SRC_PERF_CPU = 110,
   IG_SRC_BLK_TRACE = 111,
   IG_SRC_TCP_BYTES = 112,
+  IG_SRC_AUDIT = 113,
   IG_SRC_PKT_DNS = 200,
   IG_SRC_PKT_SNI = 201,
   IG_SRC_PKT_FLOW = 202,
@@ -156,6 +158,9 @@ uint64_t ig_source_create_cfg(uint32_t kind, const char* cfg,
     case IG_SRC_TCP_BYTES:
       s = new TcpBytesSource(cap, c);
       break;
+    case IG_SRC_AUDIT:
+      s = new AuditSource(cap, c);
+      break;
     default:
       return 0;
   }
@@ -248,6 +253,15 @@ int ig_blktrace_supported() {
 int ig_tcpinfo_supported() {
 #ifdef __linux__
   return TcpBytesSource::supported() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// Host-wide audit window available? (NETLINK_AUDIT + READLOG multicast)
+int ig_audit_supported() {
+#ifdef __linux__
+  return AuditSource::supported() ? 1 : 0;
 #else
   return 0;
 #endif

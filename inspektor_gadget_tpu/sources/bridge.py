@@ -35,6 +35,7 @@ SRC_FANOTIFY_RUNC = 109
 SRC_PERF_CPU = 110
 SRC_BLK_TRACE = 111
 SRC_TCP_BYTES = 112
+SRC_AUDIT = 113
 SRC_PKT_DNS = 200
 SRC_PKT_SNI = 201
 SRC_PKT_FLOW = 202
@@ -42,7 +43,7 @@ SRC_PKT_FLOW = 202
 # kinds that take a "key=value\x1f..." config string (create_cfg path)
 _CFG_KINDS = {SRC_FANOTIFY_OPEN, SRC_MOUNTINFO, SRC_SOCK_DIAG, SRC_KMSG_OOM,
               SRC_PTRACE, SRC_FANOTIFY_RUNC, SRC_PERF_CPU, SRC_BLK_TRACE,
-              SRC_TCP_BYTES}
+              SRC_TCP_BYTES, SRC_AUDIT}
 
 
 def make_cfg(**kw) -> str:
@@ -114,6 +115,8 @@ def _load_and_bind(rebuild: bool):
     lib.ig_blktrace_supported.restype = ctypes.c_int
     lib.ig_tcpinfo_supported.argtypes = []
     lib.ig_tcpinfo_supported.restype = ctypes.c_int
+    lib.ig_audit_supported.argtypes = []
+    lib.ig_audit_supported.restype = ctypes.c_int
     for fn in ("ig_source_start", "ig_source_stop", "ig_source_destroy"):
         getattr(lib, fn).argtypes = [u64]
         getattr(lib, fn).restype = ctypes.c_int
@@ -188,6 +191,12 @@ def fanotify_supported() -> bool:
     return lib is not None and bool(lib.ig_fanotify_supported())
 
 
+def audit_supported() -> bool:
+    """Host-wide kernel audit window (NETLINK_AUDIT readlog multicast)."""
+    lib = _load()
+    return lib is not None and bool(lib.ig_audit_supported())
+
+
 _SRC_KIND_NAMES = {
     SRC_SYNTH_EXEC: "synth/exec", SRC_SYNTH_TCP: "synth/tcp",
     SRC_SYNTH_DNS: "synth/dns", SRC_PROC_EXEC: "netlink/proc",
@@ -196,7 +205,8 @@ _SRC_KIND_NAMES = {
     SRC_SOCK_DIAG: "sock_diag", SRC_KMSG_OOM: "kmsg/oom",
     SRC_PTRACE: "ptrace", SRC_FANOTIFY_RUNC: "fanotify/runc",
     SRC_PERF_CPU: "perf/cpu", SRC_BLK_TRACE: "blk/trace",
-    SRC_TCP_BYTES: "sock_diag/tcpinfo", SRC_PKT_DNS: "pkt/dns",
+    SRC_TCP_BYTES: "sock_diag/tcpinfo", SRC_AUDIT: "netlink/audit",
+    SRC_PKT_DNS: "pkt/dns",
     SRC_PKT_SNI: "pkt/sni", SRC_PKT_FLOW: "pkt/flow",
 }
 

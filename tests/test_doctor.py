@@ -29,7 +29,7 @@ def test_probe_windows_names_and_shape():
     windows = probe_windows()
     expected = {"native_lib", "fanotify", "perf", "kmsg", "ptrace",
                 "sock_diag", "netlink_proc", "af_packet", "mountinfo",
-                "procfs", "blktrace", "tcpinfo"}
+                "procfs", "blktrace", "tcpinfo", "audit"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail
@@ -54,8 +54,10 @@ def test_gadget_report_reflects_live_windows():
         assert by_name[("trace", "open")].status == "real"
     if windows["mountinfo"].ok:
         assert by_name[("trace", "mount")].status == "real"
-    if windows["ptrace"].ok:
+    if windows["audit"].ok:
         assert by_name[("trace", "capabilities")].status == "real"
+    elif windows["ptrace"].ok:  # audit down → ptrace per-target fallback
+        assert by_name[("trace", "capabilities")].status == "degraded"
     # a window reported down must degrade/unavail its gadget, never "real"
     down = dict(windows)
     import dataclasses
@@ -83,9 +85,12 @@ def test_doctor_cli_command():
 
 @needs_native
 @pytest.mark.parametrize("category,name", [
-    ("trace", "capabilities"), ("trace", "fsslower"), ("audit", "seccomp"),
+    ("trace", "fsslower"),
 ])
 def test_no_target_ptrace_gadget_fails_loudly(category, name):
+    """fsslower has no host-wide window: a no-target run must error, never
+    fabricate. (capabilities and audit/seccomp gained a host-wide audit
+    flavour and now run targetless — covered in test_gadgets.)"""
     desc = get(category, name)
     params = desc.params().to_params()  # source defaults to auto, no target
     ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
@@ -95,6 +100,25 @@ def test_no_target_ptrace_gadget_fails_loudly(category, name):
     assert errs, "no-target ptrace gadget ran without erroring"
     assert "target" in str(errs).lower()
     assert not events, "fabricated events emitted despite the error"
+
+
+@needs_native
+@pytest.mark.parametrize("category,name", [
+    ("trace", "capabilities"), ("audit", "seccomp"),
+])
+def test_no_target_without_audit_window_fails_loudly(category, name):
+    """When the host-wide audit window is absent too, the no-target run
+    still errors loudly instead of fabricating."""
+    from inspektor_gadget_tpu.sources.bridge import audit_supported
+    if audit_supported():
+        pytest.skip("audit window available — host-wide flavour applies")
+    desc = get(category, name)
+    params = desc.params().to_params()
+    ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
+    events = []
+    result = LocalRuntime().run_gadget(ctx, on_event=events.append)
+    assert result.errors()
+    assert not events
 
 
 @needs_native
@@ -201,8 +225,9 @@ def test_container_filter_auto_attach_through_runtime():
 @needs_root
 def test_no_selector_means_no_auto_attach():
     """Without a container selector the Attacher gate stays closed: the
-    gadget must error loudly, not ptrace every discovered process."""
-    desc = get("trace", "capabilities")
+    gadget must error loudly, not ptrace every discovered process.
+    (fsslower: the one ptrace gadget with no host-wide audit flavour.)"""
+    desc = get("trace", "fsslower")
     params = desc.params().to_params()
     ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
     result = LocalRuntime().run_gadget(ctx)
