@@ -151,17 +151,19 @@ class AgentClient:
 
     # -- container hooks (ref: hooks/oci/main.go) ---------------------------
 
-    def add_container(self, container: dict) -> dict:
+    def add_container(self, container: dict,
+                      timeout: float = CONNECT_TIMEOUT) -> dict:
         method = self.channel.unary_unary(
             "/igtpu.GadgetManager/AddContainer",
             request_serializer=wire.identity_serializer,
             response_deserializer=wire.identity_deserializer,
         )
         h, _ = wire.decode_msg(method(wire.encode_msg({"container": container}),
-                                      timeout=CONNECT_TIMEOUT))
+                                      timeout=timeout))
         return h
 
-    def remove_container(self, container_id: str) -> dict:
+    def remove_container(self, container_id: str,
+                         timeout: float = CONNECT_TIMEOUT) -> dict:
         method = self.channel.unary_unary(
             "/igtpu.GadgetManager/RemoveContainer",
             request_serializer=wire.identity_serializer,
@@ -169,7 +171,7 @@ class AgentClient:
         )
         h, _ = wire.decode_msg(method(
             wire.encode_msg({"container": {"id": container_id}}),
-            timeout=CONNECT_TIMEOUT))
+            timeout=timeout))
         return h
 
     def dump_state(self) -> dict:

@@ -34,6 +34,14 @@ def main(argv=None) -> int:
     sp.add_argument("--informer-interval", type=float, default=2.0)
     sp.add_argument("--no-doctor", action="store_true",
                     help="skip the capture-window probe at startup")
+    sp.add_argument("--install-hooks", action="store_true",
+                    help="install runtime hooks on the host before "
+                         "serving, remove them on shutdown "
+                         "(entrypoint.sh:83-142 parity)")
+    sp.add_argument("--host-root", default="/",
+                    help="host filesystem mount point for hook installs")
+    sp.add_argument("--hook-mode", default="auto",
+                    choices=("auto", "oci", "nri", "fanotify"))
 
     for name in ("liveness", "dump"):
         p = sub.add_parser(name)
@@ -50,7 +58,44 @@ def main(argv=None) -> int:
     rcp.add_argument("--target", default="unix:///tmp/igtpu-agent.sock")
     rcp.add_argument("--id", required=True)
 
+    # hook installation on the host (ref: entrypoint.sh:83-142) and the
+    # hook invocation itself (ref: hooks/oci/main.go)
+    ihp = sub.add_parser("install-hooks")
+    ihp.add_argument("--host-root", default="/")
+    ihp.add_argument("--mode", default="auto",
+                     choices=("auto", "oci", "nri", "fanotify"))
+    ihp.add_argument("--socket", default="unix:///tmp/igtpu-agent.sock")
+
+    uhp = sub.add_parser("uninstall-hooks")
+    uhp.add_argument("--host-root", default="/")
+
+    ohp = sub.add_parser("oci-hook")
+    ohp.add_argument("--socket", default="unix:///tmp/igtpu-agent.sock")
+    ohp.add_argument("--stage", default="prestart",
+                     choices=("prestart", "poststop"))
+    ohp.add_argument("--nri", action="store_true",
+                     help="payload is an NRI event wrapper, not OCI state")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "install-hooks":
+        from .hooks import HookInstaller
+        res = HookInstaller(args.host_root, args.socket).install(args.mode)
+        print(f"hook mode: {res.mode}")
+        for p in res.installed:
+            print(f"installed {p}")
+        for n in res.notes:
+            print(n)
+        return 0 if (res.installed or res.mode == "fanotify") else 1
+    if args.cmd == "uninstall-hooks":
+        from .hooks import HookInstaller
+        for p in HookInstaller(args.host_root).uninstall():
+            print(f"removed {p}")
+        return 0
+    if args.cmd == "oci-hook":
+        from .hooks import run_oci_hook
+        return run_oci_hook(args.stage, args.socket, sys.stdin,
+                            nri=args.nri)
 
     if args.cmd == "serve":
         # entrypoint-analogue environment probe (ref: entrypoint.sh:21-120
@@ -60,32 +105,20 @@ def main(argv=None) -> int:
         if not args.no_doctor:
             from ..doctor import render_report
             print(render_report(), flush=True)
-        from .service import serve
-        server, _agent = serve(args.listen, node_name=args.node_name)
-        if args.pod_manifest or args.kube_api:
-            # pod-informer discovery feeding the localmanager collection
-            # (ref: WithPodInformer wired in main.go's serve path)
-            from ..containers import (
-                file_pod_source, kube_api_pod_source, with_pod_informer,
-            )
-            from ..operators.operators import ensure_initialized
-            lm = ensure_initialized("localmanager")
-            src = (file_pod_source(args.pod_manifest) if args.pod_manifest
-                   else kube_api_pod_source(args.kube_api,
-                                            node_name=args.node_name))
-            with_pod_informer(src, node_name=args.node_name,
-                              interval=args.informer_interval)(lm.cc)
-        print(f"ig-tpu-agent listening on {args.listen}", flush=True)
-        stop = [False]
-
-        def on_sig(*_):
-            stop[0] = True
-        signal.signal(signal.SIGTERM, on_sig)
-        signal.signal(signal.SIGINT, on_sig)
-        while not stop[0]:
-            time.sleep(0.2)
-        server.stop(grace=2.0)
-        return 0
+        installer = None
+        if args.install_hooks:
+            from .hooks import HookInstaller
+            installer = HookInstaller(args.host_root, args.listen)
+            res = installer.install(args.hook_mode)
+            print(f"hook mode: {res.mode} "
+                  f"({len(res.installed)} files installed)", flush=True)
+        # anything failing past this point must still remove the hooks:
+        # stale prestart configs stall every container creation on the host
+        try:
+            return _serve_loop(args)
+        finally:
+            if installer is not None:
+                installer.uninstall()
 
     from .client import AgentClient
     client = AgentClient(args.target)
@@ -111,6 +144,35 @@ def main(argv=None) -> int:
         print(client.remove_container(args.id))
         return 0
     return 2
+
+
+def _serve_loop(args) -> int:
+    from .service import serve
+    server, _agent = serve(args.listen, node_name=args.node_name)
+    if args.pod_manifest or args.kube_api:
+        # pod-informer discovery feeding the localmanager collection
+        # (ref: WithPodInformer wired in main.go's serve path)
+        from ..containers import (
+            file_pod_source, kube_api_pod_source, with_pod_informer,
+        )
+        from ..operators.operators import ensure_initialized
+        lm = ensure_initialized("localmanager")
+        src = (file_pod_source(args.pod_manifest) if args.pod_manifest
+               else kube_api_pod_source(args.kube_api,
+                                        node_name=args.node_name))
+        with_pod_informer(src, node_name=args.node_name,
+                          interval=args.informer_interval)(lm.cc)
+    print(f"ig-tpu-agent listening on {args.listen}", flush=True)
+    stop = [False]
+
+    def on_sig(*_):
+        stop[0] = True
+    signal.signal(signal.SIGTERM, on_sig)
+    signal.signal(signal.SIGINT, on_sig)
+    while not stop[0]:
+        time.sleep(0.2)
+    server.stop(grace=2.0)
+    return 0
 
 
 if __name__ == "__main__":
