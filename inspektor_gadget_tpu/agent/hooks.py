@@ -53,6 +53,7 @@ class InstallResult:
     mode: str                    # oci | nri | fanotify | none
     installed: list[str]         # files written/updated on the host
     notes: list[str]
+    degraded: bool = False       # mode is a fallback from a failed install
 
 
 def detect_hook_mode(host_root: str = "/") -> str:
@@ -183,7 +184,7 @@ class HookInstaller:
             # the in-process fanotify watch (same role, no install needed)
             notes.append(f"NRI install failed ({e}); falling back to the "
                          "in-process fanotify watch")
-            return InstallResult("fanotify", installed, notes)
+            return InstallResult("fanotify", installed, notes, degraded=True)
         return InstallResult("nri", installed, notes)
 
     # -- uninstall ----------------------------------------------------------

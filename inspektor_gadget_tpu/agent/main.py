@@ -86,7 +86,14 @@ def main(argv=None) -> int:
             print(f"installed {p}")
         for n in res.notes:
             print(n)
-        return 0 if (res.installed or res.mode == "fanotify") else 1
+        if res.mode == "fanotify":
+            # nothing installable: the watch runs inside the serving agent
+            # — only a success if that's what was asked for/detected, not
+            # a silent degrade from a failed NRI install
+            print("note: fanotify discovery runs in the serving agent "
+                  "process (serve wires it), no host files needed")
+            return 1 if res.degraded else 0
+        return 0 if res.installed else 1
     if args.cmd == "uninstall-hooks":
         from .hooks import HookInstaller
         for p in HookInstaller(args.host_root).uninstall():
@@ -105,20 +112,7 @@ def main(argv=None) -> int:
         if not args.no_doctor:
             from ..doctor import render_report
             print(render_report(), flush=True)
-        installer = None
-        if args.install_hooks:
-            from .hooks import HookInstaller
-            installer = HookInstaller(args.host_root, args.listen)
-            res = installer.install(args.hook_mode)
-            print(f"hook mode: {res.mode} "
-                  f"({len(res.installed)} files installed)", flush=True)
-        # anything failing past this point must still remove the hooks:
-        # stale prestart configs stall every container creation on the host
-        try:
-            return _serve_loop(args)
-        finally:
-            if installer is not None:
-                installer.uninstall()
+        return _serve_loop(args)
 
     from .client import AgentClient
     client = AgentClient(args.target)
@@ -148,29 +142,53 @@ def main(argv=None) -> int:
 
 def _serve_loop(args) -> int:
     from .service import serve
+    # bind BEFORE installing hooks: a prestart config pointing at a socket
+    # nobody serves stalls every container creation on the host
     server, _agent = serve(args.listen, node_name=args.node_name)
-    if args.pod_manifest or args.kube_api:
-        # pod-informer discovery feeding the localmanager collection
-        # (ref: WithPodInformer wired in main.go's serve path)
-        from ..containers import (
-            file_pod_source, kube_api_pod_source, with_pod_informer,
-        )
-        from ..operators.operators import ensure_initialized
-        lm = ensure_initialized("localmanager")
-        src = (file_pod_source(args.pod_manifest) if args.pod_manifest
-               else kube_api_pod_source(args.kube_api,
-                                        node_name=args.node_name))
-        with_pod_informer(src, node_name=args.node_name,
-                          interval=args.informer_interval)(lm.cc)
-    print(f"ig-tpu-agent listening on {args.listen}", flush=True)
-    stop = [False]
+    installer = None
+    try:
+        if args.install_hooks:
+            from .hooks import HookInstaller
+            installer = HookInstaller(args.host_root, args.listen)
+            res = installer.install(args.hook_mode)
+            print(f"hook mode: {res.mode} "
+                  f"({len(res.installed)} files installed)", flush=True)
+            if res.mode == "fanotify":
+                # nothing on the host invokes us: run the in-process runc
+                # fanotify watch so container tracking still works (ref:
+                # entrypoint.sh fanotify hook mode → the daemon's own
+                # watch, runcfanotify.go)
+                from ..containers import with_fanotify_discovery
+                from ..operators.operators import ensure_initialized
+                with_fanotify_discovery()(
+                    ensure_initialized("localmanager").cc)
+        if args.pod_manifest or args.kube_api:
+            # pod-informer discovery feeding the localmanager collection
+            # (ref: WithPodInformer wired in main.go's serve path)
+            from ..containers import (
+                file_pod_source, kube_api_pod_source, with_pod_informer,
+            )
+            from ..operators.operators import ensure_initialized
+            lm = ensure_initialized("localmanager")
+            src = (file_pod_source(args.pod_manifest) if args.pod_manifest
+                   else kube_api_pod_source(args.kube_api,
+                                            node_name=args.node_name))
+            with_pod_informer(src, node_name=args.node_name,
+                              interval=args.informer_interval)(lm.cc)
+        print(f"ig-tpu-agent listening on {args.listen}", flush=True)
+        stop = [False]
 
-    def on_sig(*_):
-        stop[0] = True
-    signal.signal(signal.SIGTERM, on_sig)
-    signal.signal(signal.SIGINT, on_sig)
-    while not stop[0]:
-        time.sleep(0.2)
+        def on_sig(*_):
+            stop[0] = True
+        signal.signal(signal.SIGTERM, on_sig)
+        signal.signal(signal.SIGINT, on_sig)
+        while not stop[0]:
+            time.sleep(0.2)
+    finally:
+        # uninstall while still serving, then stop: containers created in
+        # the grace window must not invoke hooks against a dead socket
+        if installer is not None:
+            installer.uninstall()
     server.stop(grace=2.0)
     return 0
 

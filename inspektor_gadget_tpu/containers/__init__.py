@@ -13,6 +13,7 @@ from .tracer_collection import TracerCollection
 from .options import (
     with_fake_containers,
     with_fallback_pod_informer,
+    with_fanotify_discovery,
     with_host,
     with_oci_config_enrichment,
     with_pod_informer,
@@ -35,7 +36,8 @@ __all__ = [
     "Container", "ContainerSelector",
     "ContainerCollection", "EventType", "PubSubEvent",
     "TracerCollection",
-    "with_fake_containers", "with_procfs_discovery", "with_node_name",
+    "with_fake_containers", "with_procfs_discovery",
+    "with_fanotify_discovery", "with_node_name",
     "with_cgroup_enrichment", "with_linux_namespace_enrichment",
     "with_pod_informer", "with_fallback_pod_informer",
     "with_host", "with_oci_config_enrichment", "with_runtime_enrichment",

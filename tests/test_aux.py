@@ -192,3 +192,23 @@ def test_windowed_example_scripts_importable():
     import examples.custom_gadget  # registers trace/heartbeat
     from inspektor_gadget_tpu.gadgets import get
     assert get("trace", "heartbeat").description
+
+
+def test_baseline_configs_bench_emits_records(capsys):
+    """benchmarks/configs.py: each BASELINE config emits one JSON record
+    with platform + metric (driver-runnable; short window here)."""
+    import json as _json
+
+    from benchmarks.configs import main as configs_main
+
+    rc = configs_main(["--seconds", "0.3", "--configs", "2,3,5"])
+    assert rc == 0
+    recs = [_json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()]
+    by_cfg = {r["config"]: r for r in recs}
+    assert set(by_cfg) == {2, 3, 5}
+    assert all("platform" in r and "error" not in r for r in recs)
+    # sketch accuracy invariants hold even at a short window
+    assert by_cfg[2]["value"] < 0.05          # HLL distinct error
+    assert by_cfg[3]["value"] < 0.01          # heavy-hitter error
+    assert by_cfg[5]["value"] < 50.0          # merge p50 ms target
