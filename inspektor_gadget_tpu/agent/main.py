@@ -186,10 +186,12 @@ def _serve_loop(args) -> int:
             time.sleep(0.2)
     finally:
         # uninstall while still serving, then stop: containers created in
-        # the grace window must not invoke hooks against a dead socket
+        # the grace window must not invoke hooks against a dead socket —
+        # and stop unconditionally, else a failed informer/install leaves
+        # non-daemon gRPC workers keeping a dead agent alive
         if installer is not None:
             installer.uninstall()
-    server.stop(grace=2.0)
+        server.stop(grace=2.0)
     return 0
 
 

@@ -157,6 +157,20 @@ def _probe_audit() -> Window:
         return Window("audit", False, repr(e))
 
 
+def _probe_captrace() -> Window:
+    # cap_capable tracepoint (tracefs, kernel >= 5.17) — capable.bpf.c's
+    # exact hook point, no BPF
+    try:
+        from .sources.bridge import captrace_supported
+        ok = captrace_supported()
+        return Window("captrace", ok,
+                      "cap_capable tracepoint ok" if ok else
+                      "cap_capable tracepoint unavailable "
+                      "(tracefs or kernel < 5.17)")
+    except Exception as e:  # noqa: BLE001
+        return Window("captrace", False, repr(e))
+
+
 def _probe_tcpinfo() -> Window:
     # top/tcp byte counters: sock_diag ext INET_DIAG_INFO (kernel >= 4.1)
     try:
@@ -203,7 +217,7 @@ _PROBES = (
     _probe_native_lib, _probe_fanotify, _probe_perf, _probe_kmsg,
     _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
-    _probe_audit,
+    _probe_audit, _probe_captrace,
 )
 
 
@@ -273,9 +287,11 @@ _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
     # host-wide audit windows with the ptrace per-target flavour as the
     # labeled fallback (ref: capable.bpf.c / audit-seccomp.bpf.c are
     # system-wide kprobes)
-    ("trace", "capabilities"): ("audit", "ptrace",
-                                "host-wide EPERM/EACCES denial records; "
-                                "ptrace per-target flavour also sees allows"),
+    ("trace", "capabilities"): ("captrace", "audit|ptrace",
+                                "cap_capable tracepoint (every check, "
+                                "allow+deny verdicts); audit EPERM-rule "
+                                "fallback is denial-only; ptrace flavour "
+                                "per-target"),
     ("audit", "seccomp"): ("audit", "ptrace",
                            "host-wide AUDIT_SECCOMP records; ptrace "
                            "per-target flavour also sees RET_ERRNO"),
@@ -312,14 +328,20 @@ def gadget_report(windows: dict[str, Window] | None = None) -> list[GadgetStatus
             elif windows.get(window) and windows[window].ok:
                 out.append(GadgetStatus(desc.category, desc.name, "real",
                                         window, note))
-            elif fallback and windows.get(fallback) and windows[fallback].ok:
-                out.append(GadgetStatus(
-                    desc.category, desc.name, "degraded", fallback,
-                    f"{window} unavailable ({windows[window].detail}); {note}"))
             else:
-                out.append(GadgetStatus(desc.category, desc.name,
-                                        "unavailable", window,
-                                        windows[window].detail))
+                # "a|b" fallback chains: first probing-ok window wins
+                fb_ok = next((f for f in fallback.split("|")
+                              if f and windows.get(f) and windows[f].ok),
+                             "") if fallback else ""
+                if fb_ok:
+                    out.append(GadgetStatus(
+                        desc.category, desc.name, "degraded", fb_ok,
+                        f"{window} unavailable "
+                        f"({windows[window].detail}); {note}"))
+                else:
+                    out.append(GadgetStatus(desc.category, desc.name,
+                                            "unavailable", window,
+                                            windows[window].detail))
             continue
         if native_kind is None:
             out.append(GadgetStatus(desc.category, desc.name, "synthetic-only",

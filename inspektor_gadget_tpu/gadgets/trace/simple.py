@@ -299,13 +299,15 @@ class CapabilityEvent(_Base):
 
 
 class TraceCapabilities(_PtraceTargetMixin, SourceTraceGadget):
-    """Two real windows (ref capable.bpf.c:1-250 is host-wide):
-    - no target → the kernel audit stream with EPERM/EACCES exit rules
-      (native/audit_source.cc): every failed privileged syscall on the
-      host maps to its implied capability — host-wide denial coverage;
-    - --command/--pid or container filter → the ptrace stream, which also
-      observes ALLOWED capability exercises (the audit window only sees
-      denials, so allow-verdict rows need a target)."""
+    """Three real windows (ref capable.bpf.c:1-250 is host-wide), picked
+    in fidelity order:
+    - no target, kernel >= 5.17: the cap_capable TRACEPOINT via tracefs
+      (native/watchers.cc CapTraceSource) — the reference's exact hook
+      point, every check on the host with allow AND deny verdicts;
+    - no target, older kernels: the kernel audit stream with EPERM/EACCES
+      exit rules (native/audit_source.cc) — host-wide denial coverage;
+    - --command/--pid or container filter: the ptrace stream (per-target,
+      observes allows too)."""
 
     native_kind = B.SRC_PTRACE
     synth_kind = B.SRC_SYNTH_EXEC
@@ -315,18 +317,23 @@ class TraceCapabilities(_PtraceTargetMixin, SourceTraceGadget):
         super().__init__(ctx)
         self._target_params()
         # an explicit synthetic run must not probe (or build) the native lib
-        self._host_wide = (self._mode not in ("synthetic", "pysynthetic")
-                           and not self._command and not self._target_pid
-                           and B.audit_supported())
-        if self._host_wide:
-            self.native_kind = B.SRC_AUDIT
+        self._host_wide = False
+        if (self._mode not in ("synthetic", "pysynthetic")
+                and not self._command and not self._target_pid):
+            if B.captrace_supported():
+                self._host_wide = True
+                self.native_kind = B.SRC_CAP_TRACE
+            elif B.audit_supported():
+                self._host_wide = True
+                self.native_kind = B.SRC_AUDIT
 
     def native_ready(self) -> bool:
         return self._host_wide or _PtraceTargetMixin.native_ready(self)
 
     def native_cfg(self) -> str:
         if self._host_wide:
-            return B.make_cfg(eperm_rules=1)
+            return (B.make_cfg(eperm_rules=1)
+                    if self.native_kind == B.SRC_AUDIT else "")
         return _PtraceTargetMixin.native_cfg(self)
 
     def decode_row(self, batch, i):

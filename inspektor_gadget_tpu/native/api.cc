@@ -56,6 +56,7 @@ enum {
   IG_SRC_BLK_TRACE = 111,
   IG_SRC_TCP_BYTES = 112,
   IG_SRC_AUDIT = 113,
+  IG_SRC_CAP_TRACE = 114,
   IG_SRC_PKT_DNS = 200,
   IG_SRC_PKT_SNI = 201,
   IG_SRC_PKT_FLOW = 202,
@@ -161,6 +162,9 @@ uint64_t ig_source_create_cfg(uint32_t kind, const char* cfg,
     case IG_SRC_AUDIT:
       s = new AuditSource(cap, c);
       break;
+    case IG_SRC_CAP_TRACE:
+      s = new CapTraceSource(cap, c);
+      break;
     default:
       return 0;
   }
@@ -262,6 +266,15 @@ int ig_tcpinfo_supported() {
 int ig_audit_supported() {
 #ifdef __linux__
   return AuditSource::supported() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// cap_capable tracepoint window available? (tracefs, kernel >= 5.17)
+int ig_captrace_supported() {
+#ifdef __linux__
+  return CapTraceSource::supported() ? 1 : 0;
 #else
   return 0;
 #endif

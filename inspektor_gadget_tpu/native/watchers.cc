@@ -23,8 +23,11 @@
 #include <fcntl.h>
 #include <poll.h>
 #include <sys/fanotify.h>
+#include <sys/mount.h>
 #include <sys/stat.h>
 #include <unistd.h>
+
+#include <mutex>
 
 #include <dirent.h>
 #include <linux/inet_diag.h>
@@ -817,6 +820,38 @@ class KmsgOomSource : public Source {
 //   aux2      bytes<<8 | is_write     pid/comm  issuing task
 // ---------------------------------------------------------------------------
 
+// Shared tracefs root discovery with auto-mount. The reference's
+// entrypoint remounts kernel filesystems the capture layer needs
+// (entrypoint.sh bpffs remount); the tracefs analogue: when neither
+// standard mount point exists, mount a private tracefs instance under
+// /run — requires CAP_SYS_ADMIN, degrades to "" without it. The mount is
+// left in place (like the entrypoint's bpffs) — it is a kernel view, not
+// per-process state, and repeated mounts are satisfied by the cache.
+inline std::string tracefs_root() {
+  static std::mutex mu;
+  static std::string cached;
+  static bool resolved = false;
+  std::lock_guard<std::mutex> g(mu);
+  if (resolved) return cached;
+  for (const char* p : {"/sys/kernel/tracing", "/sys/kernel/debug/tracing"}) {
+    std::string ev = std::string(p) + "/events";
+    if (access(ev.c_str(), R_OK) == 0) {
+      cached = p;
+      resolved = true;
+      return cached;
+    }
+  }
+  const char* priv = "/run/igtpu_tracefs";
+  mkdir(priv, 0700);
+  std::string ev = std::string(priv) + "/events";
+  if (access(ev.c_str(), R_OK) == 0 ||
+      mount("tracefs", priv, "tracefs", 0, nullptr) == 0) {
+    if (access(ev.c_str(), R_OK) == 0) cached = priv;
+  }
+  resolved = true;
+  return cached;
+}
+
 class BlkTraceSource : public Source {
  public:
   BlkTraceSource(size_t ring_pow2, const std::string& cfg)
@@ -839,11 +874,10 @@ class BlkTraceSource : public Source {
   }
 
   static std::string find_tracefs() {
-    for (const char* p : {"/sys/kernel/tracing", "/sys/kernel/debug/tracing"}) {
-      std::string ev = std::string(p) + "/events/block";
-      if (access(ev.c_str(), R_OK) == 0) return p;
-    }
-    return "";
+    std::string root = tracefs_root();
+    if (root.empty()) return "";
+    std::string ev = root + "/events/block";
+    return access(ev.c_str(), R_OK) == 0 ? root : "";
   }
 
   static bool supported() { return !find_tracefs().empty(); }
@@ -983,6 +1017,142 @@ class BlkTraceSource : public Source {
   std::string instance_;
   bool made_instance_ = false;
   std::unordered_map<std::string, Pending> inflight_;
+};
+
+// ---------------------------------------------------------------------------
+// CapTraceSource — trace/capabilities via the cap_capable TRACEPOINT.
+//
+// The reference kprobes cap_capable (capable.bpf.c:1-250) to see every
+// capability check on the host with its verdict. Kernels >= 5.17 expose
+// the same function as a real tracepoint (events/capability/cap_capable
+// with cap + ret fields) — the exact mechanism, no BPF: a private tracefs
+// instance enables it and trace_pipe lines carry
+//   comm-pid [cpu] flags ts: cap_capable: cred .., target_ns ..,
+//   capable_ns .., cap 21, ret 0
+// This window sees ALLOWS and DENIES system-wide, strictly stronger than
+// the audit EPERM-rule flavour (denial-only). Events:
+//   kind EV_CAPABILITY   aux1 = 1 allow / 0 deny   aux2 = capability nr
+// ---------------------------------------------------------------------------
+
+class CapTraceSource : public Source {
+ public:
+  CapTraceSource(size_t ring_pow2, const std::string& cfg)
+      : Source(ring_pow2) {
+    (void)cfg;
+    static std::atomic<int> seq{0};
+    char inst[64];
+    snprintf(inst, sizeof(inst), "igtpu_cap_%d_%d", (int)getpid(),
+             seq.fetch_add(1));
+    instance_ = inst;
+  }
+  ~CapTraceSource() override {
+    stop();
+    teardown();
+  }
+
+  static bool supported() {
+    std::string root = tracefs_root();
+    if (root.empty()) return false;
+    std::string ev = root + "/events/capability/cap_capable";
+    return access(ev.c_str(), R_OK) == 0;
+  }
+
+ protected:
+  void run() override {
+    std::string root = tracefs_root();
+    if (root.empty()) return;
+    std::string inst = root + "/instances/" + instance_;
+    mkdir(inst.c_str(), 0700);
+    if (access(inst.c_str(), R_OK) != 0) return;
+    made_instance_ = true;
+    if (!write_file(inst + "/events/capability/cap_capable/enable", "1"))
+      return;
+    int fd = open((inst + "/trace_pipe").c_str(),
+                  O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+    if (fd < 0) return;
+    struct pollfd pfd{fd, POLLIN, 0};
+    std::string carry;
+    while (running_.load(std::memory_order_relaxed)) {
+      if (poll(&pfd, 1, 100) <= 0) continue;
+      char buf[16384];
+      ssize_t n = read(fd, buf, sizeof(buf));
+      if (n <= 0) continue;
+      carry.append(buf, (size_t)n);
+      size_t pos = 0, nl;
+      while ((nl = carry.find('\n', pos)) != std::string::npos) {
+        parse_line(carry.data() + pos, nl - pos);
+        pos = nl + 1;
+      }
+      carry.erase(0, pos);
+    }
+    close(fd);
+  }
+
+ private:
+  void parse_line(const char* line, size_t len) {
+    std::string s(line, len);
+    size_t m = s.find("cap_capable: ");
+    if (m == std::string::npos) return;
+    int cap = -1, ret = 0;
+    size_t cp = s.find("cap ", m);
+    if (cp == std::string::npos ||
+        sscanf(s.c_str() + cp, "cap %d, ret %d", &cap, &ret) != 2 || cap < 0)
+      return;
+    Event ev{};
+    ev.ts_ns = now_ns();
+    ev.kind = EV_CAPABILITY;
+    ev.aux1 = ret == 0 ? 1 : 0;  // allow : deny (ret is -EPERM on denial)
+    ev.aux2 = (uint64_t)cap;
+    // leading "comm-pid" field carries the checking task; it runs up to
+    // the " [cpu]" column, NOT the first space — comms may contain spaces
+    size_t ns_ = s.find_first_not_of(' ');
+    size_t sp = s.find(" [", ns_);
+    if (ns_ != std::string::npos && sp != std::string::npos && sp > ns_) {
+      std::string task = s.substr(ns_, sp - ns_);
+      while (!task.empty() && task.back() == ' ') task.pop_back();
+      size_t dash = task.rfind('-');
+      if (dash != std::string::npos) {
+        ev.pid = (uint32_t)atoi(task.c_str() + dash + 1);
+        std::string comm = task.substr(0, dash);
+        size_t c = comm.size() < sizeof(ev.comm) - 1 ? comm.size()
+                                                     : sizeof(ev.comm) - 1;
+        memcpy(ev.comm, comm.data(), c);
+        ev.key_hash = fnv1a64(comm.data(), comm.size());
+        vocab_.put(ev.key_hash, comm.data(), comm.size());
+      }
+    }
+    if (ev.pid) {
+      char path[64], link[64];
+      snprintf(path, sizeof(path), "/proc/%u/ns/mnt", ev.pid);
+      ssize_t ln = readlink(path, link, sizeof(link) - 1);
+      if (ln > 0) {
+        link[ln] = 0;
+        const char* lb = strchr(link, '[');
+        if (lb) ev.mntns = strtoull(lb + 1, nullptr, 10);
+      }
+    }
+    emit(ev);
+  }
+
+  static bool write_file(const std::string& path, const char* val) {
+    int fd = open(path.c_str(), O_WRONLY | O_CLOEXEC);
+    if (fd < 0) return false;
+    ssize_t n = write(fd, val, strlen(val));
+    close(fd);
+    return n > 0;
+  }
+
+  void teardown() {
+    if (!made_instance_) return;
+    std::string root = tracefs_root();
+    if (root.empty()) return;
+    std::string inst = root + "/instances/" + instance_;
+    write_file(inst + "/events/capability/cap_capable/enable", "0");
+    rmdir(inst.c_str());
+  }
+
+  std::string instance_;
+  bool made_instance_ = false;
 };
 
 }  // namespace ig

@@ -36,6 +36,7 @@ SRC_PERF_CPU = 110
 SRC_BLK_TRACE = 111
 SRC_TCP_BYTES = 112
 SRC_AUDIT = 113
+SRC_CAP_TRACE = 114
 SRC_PKT_DNS = 200
 SRC_PKT_SNI = 201
 SRC_PKT_FLOW = 202
@@ -43,7 +44,7 @@ SRC_PKT_FLOW = 202
 # kinds that take a "key=value\x1f..." config string (create_cfg path)
 _CFG_KINDS = {SRC_FANOTIFY_OPEN, SRC_MOUNTINFO, SRC_SOCK_DIAG, SRC_KMSG_OOM,
               SRC_PTRACE, SRC_FANOTIFY_RUNC, SRC_PERF_CPU, SRC_BLK_TRACE,
-              SRC_TCP_BYTES, SRC_AUDIT}
+              SRC_TCP_BYTES, SRC_AUDIT, SRC_CAP_TRACE}
 
 
 def make_cfg(**kw) -> str:
@@ -117,6 +118,8 @@ def _load_and_bind(rebuild: bool):
     lib.ig_tcpinfo_supported.restype = ctypes.c_int
     lib.ig_audit_supported.argtypes = []
     lib.ig_audit_supported.restype = ctypes.c_int
+    lib.ig_captrace_supported.argtypes = []
+    lib.ig_captrace_supported.restype = ctypes.c_int
     for fn in ("ig_source_start", "ig_source_stop", "ig_source_destroy"):
         getattr(lib, fn).argtypes = [u64]
         getattr(lib, fn).restype = ctypes.c_int
@@ -197,6 +200,12 @@ def audit_supported() -> bool:
     return lib is not None and bool(lib.ig_audit_supported())
 
 
+def captrace_supported() -> bool:
+    """cap_capable tracepoint window (tracefs, kernel >= 5.17)."""
+    lib = _load()
+    return lib is not None and bool(lib.ig_captrace_supported())
+
+
 _SRC_KIND_NAMES = {
     SRC_SYNTH_EXEC: "synth/exec", SRC_SYNTH_TCP: "synth/tcp",
     SRC_SYNTH_DNS: "synth/dns", SRC_PROC_EXEC: "netlink/proc",
@@ -206,7 +215,7 @@ _SRC_KIND_NAMES = {
     SRC_PTRACE: "ptrace", SRC_FANOTIFY_RUNC: "fanotify/runc",
     SRC_PERF_CPU: "perf/cpu", SRC_BLK_TRACE: "blk/trace",
     SRC_TCP_BYTES: "sock_diag/tcpinfo", SRC_AUDIT: "netlink/audit",
-    SRC_PKT_DNS: "pkt/dns",
+    SRC_CAP_TRACE: "tracefs/cap", SRC_PKT_DNS: "pkt/dns",
     SRC_PKT_SNI: "pkt/sni", SRC_PKT_FLOW: "pkt/flow",
 }
 

@@ -29,7 +29,7 @@ def test_probe_windows_names_and_shape():
     windows = probe_windows()
     expected = {"native_lib", "fanotify", "perf", "kmsg", "ptrace",
                 "sock_diag", "netlink_proc", "af_packet", "mountinfo",
-                "procfs", "blktrace", "tcpinfo", "audit"}
+                "procfs", "blktrace", "tcpinfo", "audit", "captrace"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail
@@ -54,9 +54,9 @@ def test_gadget_report_reflects_live_windows():
         assert by_name[("trace", "open")].status == "real"
     if windows["mountinfo"].ok:
         assert by_name[("trace", "mount")].status == "real"
-    if windows["audit"].ok:
+    if windows["captrace"].ok:
         assert by_name[("trace", "capabilities")].status == "real"
-    elif windows["ptrace"].ok:  # audit down → ptrace per-target fallback
+    elif windows["audit"].ok:  # tracepoint absent → audit denial-only
         assert by_name[("trace", "capabilities")].status == "degraded"
     # a window reported down must degrade/unavail its gadget, never "real"
     down = dict(windows)

@@ -390,6 +390,95 @@ def test_audit_seccomp_sees_real_denial():
 
 @needs_native
 @needs_root
+def test_captrace_source_sees_allows_and_denies():
+    """The cap_capable tracepoint window directly: it must observe BOTH
+    allowed and denied checks (the property the audit EPERM flavour
+    lacks). A root chown exercises CAP_CHOWN allowed; an unprivileged one
+    is denied."""
+    from inspektor_gadget_tpu.sources.bridge import (
+        NativeCapture, SRC_CAP_TRACE, captrace_supported,
+    )
+    if not captrace_supported():
+        pytest.skip("cap_capable tracepoint unavailable")
+    target = "/tmp/ig_captrace_probe"
+    open(target, "w").close()
+    src = NativeCapture(SRC_CAP_TRACE, ring_pow2=18, batch_size=8192)
+    src.start()
+    try:
+        time.sleep(0.5)  # instance + enable
+        allows, denies = [], []
+        deadline = time.monotonic() + 6.0
+        flip = [0]
+        while time.monotonic() < deadline and not (allows and denies):
+            # root: a REAL ownership change each time (chown to the current
+            # owner short-circuits before the capability check)
+            flip[0] ^= 1
+            os.chown(target, 65534 * flip[0], 65534 * flip[0])
+            subprocess.run(
+                ["setpriv", "--reuid", "65534", "--clear-groups",
+                 "chown", "0:0", target],
+                check=False, stderr=subprocess.DEVNULL)  # denied
+            time.sleep(0.3)
+            b = src.pop()
+            c = b.cols
+            for i in range(b.count):
+                if int(c["kind"][i]) != 12 or int(c["aux2"][i]) != 0:
+                    continue  # EV_CAPABILITY, CAP_CHOWN only
+                (allows if int(c["aux1"][i]) else denies).append(
+                    (int(c["pid"][i]), b.comm_str(i)))
+        assert allows, "no allowed CAP_CHOWN check observed"
+        assert denies, "no denied CAP_CHOWN check observed"
+        assert all(pid > 0 and comm for pid, comm in allows + denies)
+    finally:
+        src.stop()
+        src.close()
+        os.unlink(target)
+
+
+@needs_native
+@needs_root
+def test_audit_source_eperm_rules_capability_denial():
+    """The NETLINK_AUDIT flavour directly (the gadget prefers the
+    cap_capable tracepoint when available, so this window needs its own
+    coverage): EPERM exit rules surface an unprivileged chown as a
+    capability denial, and rules + audit state are restored at close."""
+    from inspektor_gadget_tpu.sources.bridge import (
+        NativeCapture, SRC_AUDIT, audit_supported, make_cfg,
+    )
+    if not audit_supported():
+        pytest.skip("audit window unavailable")
+    target = "/tmp/ig_auditsrc_probe"
+    open(target, "w").close()
+    src = NativeCapture(SRC_AUDIT, ring_pow2=16, batch_size=4096,
+                        cfg=make_cfg(eperm_rules=1))
+    src.start()
+    try:
+        time.sleep(0.8)  # rule install
+        deadline = time.monotonic() + 6.0
+        denials = []
+        while time.monotonic() < deadline and not denials:
+            subprocess.run(
+                ["setpriv", "--reuid", "65534", "--clear-groups",
+                 "chown", "0:0", target],
+                check=False, stderr=subprocess.DEVNULL)
+            time.sleep(0.3)
+            b = src.pop()
+            c = b.cols
+            for i in range(b.count):
+                if (int(c["kind"][i]) == 12       # EV_CAPABILITY
+                        and int(c["aux1"][i]) == 0  # deny
+                        and int(c["aux2"][i]) == 0):  # CAP_CHOWN
+                    denials.append((int(c["pid"][i]), int(c["uid"][i])))
+        assert denials, "no CAP_CHOWN denial from the audit window"
+        assert all(uid == 65534 for _pid, uid in denials)
+    finally:
+        src.stop()
+        src.close()
+        os.unlink(target)
+
+
+@needs_native
+@needs_root
 def test_profile_cpu_perf_sampler_real_samples():
     import inspektor_gadget_tpu.all_gadgets  # noqa: F401
     from inspektor_gadget_tpu.gadgets import GadgetContext, get
