@@ -158,7 +158,7 @@ def _probe_audit() -> Window:
 
 
 def _probe_captrace() -> Window:
-    # cap_capable tracepoint (tracefs, kernel >= 5.17) — capable.bpf.c's
+    # cap_capable tracepoint (tracefs, kernel >= 6.7) — capable.bpf.c's
     # exact hook point, no BPF
     try:
         from .sources.bridge import captrace_supported
@@ -166,9 +166,21 @@ def _probe_captrace() -> Window:
         return Window("captrace", ok,
                       "cap_capable tracepoint ok" if ok else
                       "cap_capable tracepoint unavailable "
-                      "(tracefs or kernel < 5.17)")
+                      "(tracefs or kernel < 6.7)")
     except Exception as e:  # noqa: BLE001
         return Window("captrace", False, repr(e))
+
+
+def _probe_fstrace() -> Window:
+    # raw_syscalls tracepoints with in-kernel id filter (host-wide fsslower)
+    try:
+        from .sources.bridge import fstrace_supported
+        ok = fstrace_supported()
+        return Window("fstrace", ok,
+                      "raw_syscalls tracepoints ok" if ok else
+                      "raw_syscalls tracepoints unavailable (tracefs)")
+    except Exception as e:  # noqa: BLE001
+        return Window("fstrace", False, repr(e))
 
 
 def _probe_tcpinfo() -> Window:
@@ -217,7 +229,7 @@ _PROBES = (
     _probe_native_lib, _probe_fanotify, _probe_perf, _probe_kmsg,
     _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
-    _probe_audit, _probe_captrace,
+    _probe_audit, _probe_captrace, _probe_fstrace,
 )
 
 
@@ -295,6 +307,10 @@ _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
     ("audit", "seccomp"): ("audit", "ptrace",
                            "host-wide AUDIT_SECCOMP records; ptrace "
                            "per-target flavour also sees RET_ERRNO"),
+    ("trace", "fsslower"): ("fstrace", "ptrace",
+                            "host-wide raw_syscalls entry/exit latency "
+                            "with in-kernel fs-syscall filter; ptrace "
+                            "flavour per-target"),
 }
 
 

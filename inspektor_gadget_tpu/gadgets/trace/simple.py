@@ -301,7 +301,7 @@ class CapabilityEvent(_Base):
 class TraceCapabilities(_PtraceTargetMixin, SourceTraceGadget):
     """Three real windows (ref capable.bpf.c:1-250 is host-wide), picked
     in fidelity order:
-    - no target, kernel >= 5.17: the cap_capable TRACEPOINT via tracefs
+    - no target, kernel >= 6.7: the cap_capable TRACEPOINT via tracefs
       (native/watchers.cc CapTraceSource) — the reference's exact hook
       point, every check on the host with allow AND deny verdicts;
     - no target, older kernels: the kernel audit stream with EPERM/EACCES
@@ -406,6 +406,12 @@ class FsSlowerEvent(_Base):
 
 
 class TraceFsSlower(_PtraceTargetMixin, SourceTraceGadget):
+    """Two real windows (ref fsslower.bpf.c:1-239 is host-wide):
+    - no target: filtered raw_syscalls tracepoints via tracefs
+      (native/watchers.cc FsTraceSource) — entry/exit latency for every
+      fs op on the host, in-kernel id filter, path via /proc fd resolve;
+    - --command/--pid or container filter: the ptrace stream."""
+
     native_kind = B.SRC_PTRACE
     synth_kind = B.SRC_SYNTH_EXEC
     kind_filter = (EV_FSSLOWER,)
@@ -415,8 +421,19 @@ class TraceFsSlower(_PtraceTargetMixin, SourceTraceGadget):
         self._target_params()
         p = ctx.gadget_params
         self._min_ms = p.get("min-latency").as_int() if "min-latency" in p else 10
+        self._host_wide = False
+        if (self._mode not in ("synthetic", "pysynthetic")
+                and not self._command and not self._target_pid
+                and B.fstrace_supported()):
+            self._host_wide = True
+            self.native_kind = B.SRC_FS_TRACE
+
+    def native_ready(self) -> bool:
+        return self._host_wide or _PtraceTargetMixin.native_ready(self)
 
     def native_cfg(self) -> str:
+        if self._host_wide:
+            return B.make_cfg(min_lat_us=self._min_ms * 1000)
         base = _PtraceTargetMixin.native_cfg(self)
         return base + f"\x1fmin_lat_us={self._min_ms * 1000}"
 

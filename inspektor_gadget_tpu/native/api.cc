@@ -19,6 +19,8 @@
 #include "ptrace_source.cc"
 #include "perf_sampler.cc"
 #include "audit_source.cc"
+// after ptrace_source.cc: tracefs sources share its syscall/fs-op tables
+#include "tracefs_sources.cc"
 
 using namespace ig;
 
@@ -57,6 +59,7 @@ enum {
   IG_SRC_TCP_BYTES = 112,
   IG_SRC_AUDIT = 113,
   IG_SRC_CAP_TRACE = 114,
+  IG_SRC_FS_TRACE = 115,
   IG_SRC_PKT_DNS = 200,
   IG_SRC_PKT_SNI = 201,
   IG_SRC_PKT_FLOW = 202,
@@ -165,6 +168,9 @@ uint64_t ig_source_create_cfg(uint32_t kind, const char* cfg,
     case IG_SRC_CAP_TRACE:
       s = new CapTraceSource(cap, c);
       break;
+    case IG_SRC_FS_TRACE:
+      s = new FsTraceSource(cap, c);
+      break;
     default:
       return 0;
   }
@@ -271,10 +277,19 @@ int ig_audit_supported() {
 #endif
 }
 
-// cap_capable tracepoint window available? (tracefs, kernel >= 5.17)
+// cap_capable tracepoint window available? (tracefs, kernel >= 6.7)
 int ig_captrace_supported() {
 #ifdef __linux__
   return CapTraceSource::supported() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// raw_syscalls tracepoint window available? (host-wide fsslower)
+int ig_fstrace_supported() {
+#ifdef __linux__
+  return FsTraceSource::supported() ? 1 : 0;
 #else
   return 0;
 #endif

@@ -1,0 +1,501 @@
+// Tracefs-backed capture sources — block per-IO, host-wide fsslower, and
+// the cap_capable tracepoint.
+//
+// Each source owns a PRIVATE tracing instance (instances/<name>: isolated
+// ring buffers + event enables, never disturbs global tracing), reads its
+// trace_pipe, and surfaces per-cpu ring overruns as drops. The shared
+// lifecycle lives in TracefsInstanceSource; concrete sources supply the
+// events to enable (with optional in-kernel filters) and a line parser.
+//
+// This file is included AFTER ptrace_source.cc (see api.cc) on purpose:
+// FsTraceSource reuses its kSyscallNames (arch-native syscall numbers)
+// and kSpecs fs_op classification so the per-target ptrace flavour and
+// the host-wide tracepoint flavour can never disagree about which
+// syscalls are fs ops.
+
+#ifdef __linux__
+#include <dirent.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "ringbuf.h"
+
+namespace ig {
+
+// ---------------------------------------------------------------------------
+// TracefsInstanceSource — shared private-instance lifecycle.
+// ---------------------------------------------------------------------------
+
+class TracefsInstanceSource : public Source {
+ public:
+  TracefsInstanceSource(size_t ring_pow2, const char* name_prefix,
+                        const std::string& root = "")
+      : Source(ring_pow2), root_(root) {
+    if (root_.empty()) root_ = tracefs_root();
+    static std::atomic<int> seq{0};
+    char inst[64];
+    snprintf(inst, sizeof(inst), "%s_%d_%d", name_prefix, (int)getpid(),
+             seq.fetch_add(1));
+    instance_ = inst;
+  }
+  ~TracefsInstanceSource() override { teardown_instance(); }
+
+  // A usable tracefs needs WRITE access (instance creation + event
+  // enables), not just readable event dirs — /sys is commonly mounted
+  // read-only in containers and a read-only root must not be reported
+  // as a working window.
+  static bool root_usable(const std::string& root) {
+    if (root.empty()) return false;
+    return access((root + "/instances").c_str(), W_OK) == 0;
+  }
+
+ protected:
+  // subclass contract -------------------------------------------------------
+  // relative "events/..." paths to enable, with optional in-kernel filter
+  struct EventEnable {
+    std::string event;   // e.g. "events/block/block_rq_issue"
+    std::string filter;  // "" = none
+  };
+  virtual std::vector<EventEnable> events() = 0;
+  virtual void parse_line(const char* line, size_t len) = 0;
+  // bound for per-source in-flight tables; called when the pipe drains
+  virtual void prune() {}
+
+  void run() override {
+    if (root_.empty()) return;
+    std::string inst = root_ + "/instances/" + instance_;
+    mkdir(inst.c_str(), 0700);
+    if (access(inst.c_str(), R_OK) != 0) return;
+    made_instance_ = true;
+    for (const EventEnable& e : events()) {
+      if (!e.filter.empty() &&
+          !write_file(inst + "/" + e.event + "/filter", e.filter.c_str()))
+        return;
+      if (!write_file(inst + "/" + e.event + "/enable", "1")) return;
+      // recorded for teardown: the destructor must not dispatch to the
+      // (already-destroyed) derived class's virtual events()
+      enabled_events_.push_back(e.event);
+    }
+    int fd = open((inst + "/trace_pipe").c_str(),
+                  O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+    if (fd < 0) return;
+    struct pollfd pfd{fd, POLLIN, 0};
+    std::string carry;
+    uint64_t last_overrun_check = 0;
+    while (running_.load(std::memory_order_relaxed)) {
+      if (poll(&pfd, 1, 100) <= 0) continue;
+      char buf[16384];
+      ssize_t n = read(fd, buf, sizeof(buf));
+      if (n <= 0) continue;
+      carry.append(buf, (size_t)n);
+      size_t pos = 0, nl;
+      while ((nl = carry.find('\n', pos)) != std::string::npos) {
+        parse_line(carry.data() + pos, nl - pos);
+        pos = nl + 1;
+      }
+      carry.erase(0, pos);
+      prune();
+      uint64_t now = now_ns();
+      if (now - last_overrun_check > 1000000000ull) {
+        last_overrun_check = now;
+        account_overruns(inst);
+      }
+    }
+    close(fd);
+  }
+
+  // shared helpers ----------------------------------------------------------
+
+  // leading "comm-pid" field of a trace_pipe line; runs up to the " [cpu]"
+  // column, NOT the first space — comms may contain spaces. Returns pid
+  // (0 on parse failure) and fills comm.
+  static uint32_t parse_task(const std::string& s, std::string& comm) {
+    size_t ns_ = s.find_first_not_of(' ');
+    size_t br = s.find(" [", ns_);
+    if (ns_ == std::string::npos || br == std::string::npos || br <= ns_)
+      return 0;
+    std::string task = s.substr(ns_, br - ns_);
+    while (!task.empty() && task.back() == ' ') task.pop_back();
+    size_t dash = task.rfind('-');
+    if (dash == std::string::npos) return 0;
+    comm = task.substr(0, dash);
+    return (uint32_t)atoi(task.c_str() + dash + 1);
+  }
+
+  // "12345.678901:" timestamp token directly before the event name
+  static double parse_ts(const std::string& s, size_t event_pos) {
+    if (event_pos < 2) return 0.0;
+    size_t ts_start = s.rfind(' ', event_pos - 2);
+    if (ts_start == std::string::npos) return 0.0;
+    return atof(s.c_str() + ts_start + 1);
+  }
+
+  void fill_task_identity(Event& ev, const std::string& comm) {
+    if (!comm.empty()) {
+      size_t c = comm.size() < sizeof(ev.comm) - 1 ? comm.size()
+                                                   : sizeof(ev.comm) - 1;
+      memcpy(ev.comm, comm.data(), c);
+      if (ev.key_hash == 0) {
+        ev.key_hash = fnv1a64(comm.data(), comm.size());
+        vocab_.put(ev.key_hash, comm.data(), comm.size());
+      }
+    }
+    if (ev.pid) {
+      char path[64], link[64];
+      snprintf(path, sizeof(path), "/proc/%u/ns/mnt", ev.pid);
+      ssize_t ln = readlink(path, link, sizeof(link) - 1);
+      if (ln > 0) {
+        link[ln] = 0;
+        const char* lb = strchr(link, '[');
+        if (lb) ev.mntns = strtoull(lb + 1, nullptr, 10);
+      }
+    }
+  }
+
+  static bool write_file(const std::string& path, const char* val) {
+    int fd = open(path.c_str(), O_WRONLY | O_CLOEXEC);
+    if (fd < 0) return false;
+    ssize_t n = write(fd, val, strlen(val));
+    close(fd);
+    return n > 0;
+  }
+
+  std::string root_;
+
+ private:
+  // per_cpu/*/stats "overrun: N" — events the ftrace ring discarded before
+  // we read them; folded into the source's drop counter so loss stays
+  // auditable end-to-end (ring_stress contract)
+  void account_overruns(const std::string& inst) {
+    uint64_t total = 0;
+    DIR* d = opendir((inst + "/per_cpu").c_str());
+    if (!d) return;
+    struct dirent* de;
+    while ((de = readdir(d))) {
+      if (strncmp(de->d_name, "cpu", 3) != 0) continue;
+      std::string sp = inst + "/per_cpu/" + de->d_name + "/stats";
+      FILE* f = fopen(sp.c_str(), "r");
+      if (!f) continue;
+      char line[128];
+      while (fgets(line, sizeof(line), f)) {
+        unsigned long long v;
+        if (sscanf(line, "overrun: %llu", &v) == 1) total += v;
+      }
+      fclose(f);
+    }
+    closedir(d);
+    if (total > overrun_seen_) {
+      ring_.count_external_drops(total - overrun_seen_);
+      overrun_seen_ = total;
+    }
+  }
+
+  void teardown_instance() {
+    if (!made_instance_ || root_.empty()) return;
+    std::string inst = root_ + "/instances/" + instance_;
+    for (const std::string& e : enabled_events_)
+      write_file(inst + "/" + e + "/enable", "0");
+    rmdir(inst.c_str());  // removing the instance frees its buffers
+  }
+
+  std::string instance_;
+  bool made_instance_ = false;
+  uint64_t overrun_seen_ = 0;
+  std::vector<std::string> enabled_events_;
+};
+
+// ---------------------------------------------------------------------------
+// BlkTraceSource — profile/block-io via tracefs block events, PER-IO.
+//
+// The reference's biolatency.bpf.c (1-156) kprobes rq issue→complete and
+// histograms each request's latency in-kernel. trace_pipe lines carry
+// (dev, sector, rwbs, bytes) on issue and completion, so each IO's
+// latency is the timestamp delta of its (dev,sector) pair. Events:
+//   key_hash  dev "maj,min" (vocab)   aux1  latency_us
+//   aux2      bytes<<8 | is_write     pid/comm  issuing task
+// ---------------------------------------------------------------------------
+
+class BlkTraceSource : public TracefsInstanceSource {
+ public:
+  BlkTraceSource(size_t ring_pow2, const std::string& cfg)
+      : TracefsInstanceSource(ring_pow2, "igtpu_blk",
+                              cfg_get(cfg, "tracefs", "")) {}
+  ~BlkTraceSource() override { stop(); }
+
+  static bool supported() {
+    std::string root = tracefs_root();
+    return root_usable(root) &&
+           access((root + "/events/block").c_str(), R_OK) == 0;
+  }
+
+ protected:
+  std::vector<EventEnable> events() override {
+    return {{"events/block/block_rq_issue", ""},
+            {"events/block/block_rq_complete", ""}};
+  }
+
+  void prune() override {
+    // IOs whose completion we never see (requeues, remaps) must not leak
+    if (inflight_.size() > 65536) inflight_.clear();
+  }
+
+  void parse_line(const char* line, size_t len) override {
+    std::string s(line, len);
+    // "  comm-pid  [cpu] flags ts.usec: block_rq_issue: maj,min RWBS bytes
+    //  () sector + len [comm]"   (complete: no bytes field)
+    size_t m_issue = s.find("block_rq_issue: ");
+    size_t m_done = s.find("block_rq_complete: ");
+    if (m_issue == std::string::npos && m_done == std::string::npos) return;
+    double ts = parse_ts(
+        s, m_issue != std::string::npos ? m_issue : m_done);
+    if (m_issue != std::string::npos) {
+      char dev[16] = "", rwbs[8] = "";
+      unsigned long long bytes = 0, sector = 0;
+      if (sscanf(s.c_str() + m_issue + 16, "%15s %7s %llu () %llu",
+                 dev, rwbs, &bytes, &sector) != 4)
+        return;
+      Pending p{};
+      p.ts = ts;
+      p.bytes = bytes;
+      p.is_write = strchr(rwbs, 'W') != nullptr;
+      std::string comm;
+      p.pid = parse_task(s, comm);
+      size_t cn = comm.size() < sizeof(p.comm) - 1 ? comm.size()
+                                                   : sizeof(p.comm) - 1;
+      memcpy(p.comm, comm.data(), cn);
+      p.comm[cn] = 0;
+      inflight_[key(dev, sector)] = p;
+    } else {
+      char dev[16] = "";
+      unsigned long long sector = 0;
+      if (sscanf(s.c_str() + m_done + 19, "%15s %*s () %llu",
+                 dev, &sector) != 2)
+        return;
+      auto it = inflight_.find(key(dev, sector));
+      if (it == inflight_.end()) return;
+      const Pending& p = it->second;
+      double lat_us = (ts - p.ts) * 1e6;
+      if (lat_us >= 0) {
+        Event ev{};
+        ev.ts_ns = now_ns();
+        ev.kind = EV_BLOCK_IO;
+        ev.aux1 = (uint64_t)lat_us;
+        ev.aux2 = (p.bytes << 8) | (p.is_write ? 1 : 0);
+        ev.pid = p.pid;
+        size_t dn = strlen(dev);
+        ev.key_hash = fnv1a64(dev, dn);
+        vocab_.put(ev.key_hash, dev, dn);
+        size_t cn = strlen(p.comm);
+        memcpy(ev.comm, p.comm,
+               cn < sizeof(ev.comm) - 1 ? cn : sizeof(ev.comm) - 1);
+        emit(ev);
+      }
+      inflight_.erase(it);
+    }
+  }
+
+ private:
+  struct Pending {
+    double ts;
+    uint64_t bytes;
+    uint32_t pid;
+    char comm[16];
+    bool is_write;
+  };
+
+  static std::string key(const char* dev, unsigned long long sector) {
+    char k[48];
+    snprintf(k, sizeof(k), "%s:%llu", dev, sector);
+    return k;
+  }
+
+  std::unordered_map<std::string, Pending> inflight_;
+};
+
+// ---------------------------------------------------------------------------
+// FsTraceSource — trace/fsslower HOST-WIDE via filtered raw_syscalls.
+//
+// The reference's fsslower.bpf.c (1-239) kprobes per-fs read/write/open/
+// fsync entry+exit and reports ops slower than a threshold, system-wide.
+// Here: events/raw_syscalls/{sys_enter,sys_exit} with an IN-KERNEL id
+// filter (only fs syscalls reach the ring), entry/exit paired per
+// (pid, nr):
+//   sys_enter: NR 0 (fd_hex, buf, count, ...)     sys_exit: NR 0 = 4096
+// Ops >= min_lat_us emit EV_FSSLOWER with
+//   aux1 latency_us    aux2 op<<32 | bytes (ret of read/write)
+//   key_hash           file path via /proc/<pid>/fd/<fd>, resolved only
+//                      for the slow ops that get reported (cheap)
+// The syscall set and op classes come from ptrace_source.cc's kSpecs
+// (fs_op column) — one source of truth for both fsslower flavours.
+// ---------------------------------------------------------------------------
+
+class FsTraceSource : public TracefsInstanceSource {
+ public:
+  FsTraceSource(size_t ring_pow2, const std::string& cfg)
+      : TracefsInstanceSource(ring_pow2, "igtpu_fs") {
+    min_lat_us_ = strtoull(cfg_get(cfg, "min_lat_us", "10000").c_str(),
+                           nullptr, 10);
+    // arch-native nr → fs-op class, from the ptrace window's tables
+    for (const SyscallName* s = kSyscallNames; s->name; s++) {
+      for (const SysSpec* sp = kSpecs; sp->name; sp++) {
+        if (strcmp(sp->name, s->name) == 0) {
+          if (sp->fs_op > 0) op_by_nr_[s->nr] = sp->fs_op;
+          break;
+        }
+      }
+    }
+  }
+  ~FsTraceSource() override { stop(); }
+
+  static bool supported() {
+    std::string root = tracefs_root();
+    return root_usable(root) &&
+           access((root + "/events/raw_syscalls/sys_enter").c_str(),
+                  R_OK) == 0;
+  }
+
+ protected:
+  std::vector<EventEnable> events() override {
+    std::string filter;
+    for (auto& [nr, _op] : op_by_nr_) {
+      if (!filter.empty()) filter += "||";
+      filter += "id==" + std::to_string(nr);
+    }
+    return {{"events/raw_syscalls/sys_enter", filter},
+            {"events/raw_syscalls/sys_exit", filter}};
+  }
+
+  void prune() override {
+    if (inflight_.size() > 65536) inflight_.clear();
+  }
+
+  void parse_line(const char* line, size_t len) override {
+    std::string s(line, len);
+    size_t m_in = s.find("sys_enter: NR ");
+    size_t m_out = s.find("sys_exit: NR ");
+    if (m_in == std::string::npos && m_out == std::string::npos) return;
+    std::string comm;
+    uint32_t pid = parse_task(s, comm);
+    if (!pid) return;
+    double ts = parse_ts(s, m_in != std::string::npos ? m_in : m_out);
+    if (m_in != std::string::npos) {
+      long nr = 0;
+      unsigned long long a0 = 0;
+      if (sscanf(s.c_str() + m_in + 14, "%ld (%llx", &nr, &a0) < 1) return;
+      if (!op_by_nr_.count((int)nr)) return;
+      inflight_[((uint64_t)pid << 16) | (uint64_t)(nr & 0xFFFF)] =
+          Pending{ts, a0};
+    } else {
+      long nr = 0;
+      long long ret = 0;
+      if (sscanf(s.c_str() + m_out + 13, "%ld = %lld", &nr, &ret) != 2)
+        return;
+      auto op_it = op_by_nr_.find((int)nr);
+      if (op_it == op_by_nr_.end()) return;
+      auto key = ((uint64_t)pid << 16) | (uint64_t)(nr & 0xFFFF);
+      auto it = inflight_.find(key);
+      if (it == inflight_.end()) return;
+      double lat_us = (ts - it->second.ts) * 1e6;
+      uint64_t fdnum = it->second.fd;
+      inflight_.erase(it);
+      if (lat_us < (double)min_lat_us_) return;
+      Event ev{};
+      ev.ts_ns = now_ns();
+      ev.kind = EV_FSSLOWER;
+      ev.pid = pid;
+      ev.aux1 = (uint64_t)lat_us;
+      uint64_t bytes =
+          (op_it->second == 1 || op_it->second == 2) && ret > 0
+              ? (uint64_t)ret : 0;
+      ev.aux2 = ((uint64_t)op_it->second << 32) | (bytes & 0xFFFFFFFF);
+      // only reported (slow) ops pay the fd→path resolve
+      if (op_it->second != 3 && fdnum < 65536) {
+        char link[64], path[512];
+        snprintf(link, sizeof(link), "/proc/%u/fd/%llu", pid,
+                 (unsigned long long)fdnum);
+        ssize_t pn = readlink(link, path, sizeof(path) - 1);
+        if (pn > 0) {
+          ev.key_hash = fnv1a64(path, (size_t)pn);
+          vocab_.put(ev.key_hash, path, (size_t)pn);
+        }
+      }
+      fill_task_identity(ev, comm);
+      emit(ev);
+    }
+  }
+
+ private:
+  struct Pending {
+    double ts;
+    uint64_t fd;
+  };
+
+  uint64_t min_lat_us_;
+  std::unordered_map<int, int> op_by_nr_;
+  std::unordered_map<uint64_t, Pending> inflight_;
+};
+
+// ---------------------------------------------------------------------------
+// CapTraceSource — trace/capabilities via the cap_capable TRACEPOINT.
+//
+// The reference kprobes cap_capable (capable.bpf.c:1-250) to see every
+// capability check on the host with its verdict. Kernels >= 6.7 expose
+// the same function as a real tracepoint (events/capability/cap_capable
+// with cap + ret fields) — the exact mechanism, no BPF:
+//   comm-pid [cpu] flags ts: cap_capable: cred .., target_ns ..,
+//   capable_ns .., cap 21, ret 0
+// This window sees ALLOWS and DENIES system-wide, strictly stronger than
+// the audit EPERM-rule flavour (denial-only). Events:
+//   kind EV_CAPABILITY   aux1 = 1 allow / 0 deny   aux2 = capability nr
+// ---------------------------------------------------------------------------
+
+class CapTraceSource : public TracefsInstanceSource {
+ public:
+  CapTraceSource(size_t ring_pow2, const std::string& cfg)
+      : TracefsInstanceSource(ring_pow2, "igtpu_cap") {
+    (void)cfg;
+  }
+  ~CapTraceSource() override { stop(); }
+
+  static bool supported() {
+    std::string root = tracefs_root();
+    return root_usable(root) &&
+           access((root + "/events/capability/cap_capable").c_str(),
+                  R_OK) == 0;
+  }
+
+ protected:
+  std::vector<EventEnable> events() override {
+    return {{"events/capability/cap_capable", ""}};
+  }
+
+  void parse_line(const char* line, size_t len) override {
+    std::string s(line, len);
+    size_t m = s.find("cap_capable: ");
+    if (m == std::string::npos) return;
+    int cap = -1, ret = 0;
+    size_t cp = s.find("cap ", m);
+    if (cp == std::string::npos ||
+        sscanf(s.c_str() + cp, "cap %d, ret %d", &cap, &ret) != 2 || cap < 0)
+      return;
+    Event ev{};
+    ev.ts_ns = now_ns();
+    ev.kind = EV_CAPABILITY;
+    ev.aux1 = ret == 0 ? 1 : 0;  // allow : deny (ret is -EPERM on denial)
+    ev.aux2 = (uint64_t)cap;
+    std::string comm;
+    ev.pid = parse_task(s, comm);
+    fill_task_identity(ev, comm);
+    emit(ev);
+  }
+};
+
+}  // namespace ig
+#endif  // __linux__

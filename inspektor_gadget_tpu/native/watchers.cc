@@ -806,19 +806,6 @@ class KmsgOomSource : public Source {
   }
 };
 
-// ---------------------------------------------------------------------------
-// BlkTraceSource — profile/block-io via tracefs block events, PER-IO.
-//
-// The reference's biolatency.bpf.c (1-156) kprobes rq issue→complete and
-// histograms each request's latency in-kernel. The non-BPF window onto the
-// identical kernel events is tracefs: a private tracing instance
-// (instances/<name> — isolated buffers, does not disturb global tracing)
-// with events/block/block_rq_issue + block_rq_complete enabled; trace_pipe
-// lines carry (dev, sector, rwbs, bytes) on issue and completion, so each
-// IO's latency is the timestamp delta of its (dev,sector) pair. Events:
-//   key_hash  dev "maj,min" (vocab)   aux1  latency_us
-//   aux2      bytes<<8 | is_write     pid/comm  issuing task
-// ---------------------------------------------------------------------------
 
 // Shared tracefs root discovery with auto-mount. The reference's
 // entrypoint remounts kernel filesystems the capture layer needs
@@ -851,309 +838,6 @@ inline std::string tracefs_root() {
   resolved = true;
   return cached;
 }
-
-class BlkTraceSource : public Source {
- public:
-  BlkTraceSource(size_t ring_pow2, const std::string& cfg)
-      : Source(ring_pow2) {
-    tracefs_ = cfg_get(cfg, "tracefs", "");
-    if (tracefs_.empty()) tracefs_ = find_tracefs();
-    // instance name is unique per source by default: a shared name would
-    // mean two concurrent sources splitting one consuming trace_pipe
-    // (losing issue/complete pairings) and tearing down each other's
-    // instance
-    static std::atomic<int> seq{0};
-    char def_inst[64];
-    snprintf(def_inst, sizeof(def_inst), "igtpu_blk_%d_%d", (int)getpid(),
-             seq.fetch_add(1));
-    instance_ = cfg_get(cfg, "instance", def_inst);
-  }
-  ~BlkTraceSource() override {
-    stop();
-    teardown();
-  }
-
-  static std::string find_tracefs() {
-    std::string root = tracefs_root();
-    if (root.empty()) return "";
-    std::string ev = root + "/events/block";
-    return access(ev.c_str(), R_OK) == 0 ? root : "";
-  }
-
-  static bool supported() { return !find_tracefs().empty(); }
-
- protected:
-  void run() override {
-    if (tracefs_.empty()) return;
-    std::string inst = tracefs_ + "/instances/" + instance_;
-    // a private instance isolates buffers + event enables from the global
-    // tracer; mkdir is the documented creation API
-    mkdir(inst.c_str(), 0700);
-    if (access(inst.c_str(), R_OK) != 0) return;
-    made_instance_ = true;
-    if (!write_file(inst + "/events/block/block_rq_issue/enable", "1") ||
-        !write_file(inst + "/events/block/block_rq_complete/enable", "1"))
-      return;
-    int fd = open((inst + "/trace_pipe").c_str(),
-                  O_RDONLY | O_NONBLOCK | O_CLOEXEC);
-    if (fd < 0) return;
-    struct pollfd pfd{fd, POLLIN, 0};
-    std::string carry;
-    while (running_.load(std::memory_order_relaxed)) {
-      if (poll(&pfd, 1, 100) <= 0) continue;
-      char buf[8192];
-      ssize_t n = read(fd, buf, sizeof(buf));
-      if (n <= 0) continue;
-      carry.append(buf, (size_t)n);
-      size_t pos = 0, nl;
-      while ((nl = carry.find('\n', pos)) != std::string::npos) {
-        parse_line(carry.data() + pos, nl - pos);
-        pos = nl + 1;
-      }
-      carry.erase(0, pos);
-      // bound the in-flight table: IOs whose completion we never see
-      // (requeues, remaps) must not leak
-      if (inflight_.size() > 65536) inflight_.clear();
-    }
-    close(fd);
-  }
-
- private:
-  struct Pending {
-    double ts;
-    uint64_t bytes;
-    uint32_t pid;
-    char comm[16];
-    bool is_write;
-  };
-
-  void parse_line(const char* line, size_t len) {
-    std::string s(line, len);
-    // "  comm-pid  [cpu] flags ts.usec: block_rq_issue: maj,min RWBS bytes
-    //  () sector + len [comm]"   (complete: no bytes field)
-    size_t m_issue = s.find("block_rq_issue: ");
-    size_t m_done = s.find("block_rq_complete: ");
-    if (m_issue == std::string::npos && m_done == std::string::npos) return;
-    // timestamp: the "12345.678901:" token right before the event name
-    size_t colon = (m_issue != std::string::npos ? m_issue : m_done) - 2;
-    size_t ts_start = s.rfind(' ', colon);
-    if (ts_start == std::string::npos) return;
-    double ts = atof(s.c_str() + ts_start + 1);
-    if (m_issue != std::string::npos) {
-      char dev[16] = "", rwbs[8] = "";
-      unsigned long long bytes = 0, sector = 0;
-      if (sscanf(s.c_str() + m_issue + 16, "%15s %7s %llu () %llu",
-                 dev, rwbs, &bytes, &sector) != 4)
-        return;
-      Pending p{};
-      p.ts = ts;
-      p.bytes = bytes;
-      p.is_write = strchr(rwbs, 'W') != nullptr;
-      // issuing task: leading "comm-pid" token
-      size_t ns = s.find_first_not_of(' ');
-      size_t sp = s.find(' ', ns);
-      if (ns != std::string::npos && sp != std::string::npos) {
-        std::string task = s.substr(ns, sp - ns);
-        size_t dash = task.rfind('-');
-        if (dash != std::string::npos) {
-          p.pid = (uint32_t)atoi(task.c_str() + dash + 1);
-          size_t cn = dash < sizeof(p.comm) - 1 ? dash : sizeof(p.comm) - 1;
-          memcpy(p.comm, task.data(), cn);
-        }
-      }
-      inflight_[key(dev, sector)] = p;
-    } else {
-      char dev[16] = "";
-      unsigned long long sector = 0;
-      if (sscanf(s.c_str() + m_done + 19, "%15s %*s () %llu",
-                 dev, &sector) != 2)
-        return;
-      auto it = inflight_.find(key(dev, sector));
-      if (it == inflight_.end()) return;
-      const Pending& p = it->second;
-      double lat_us = (ts - p.ts) * 1e6;
-      if (lat_us >= 0) {
-        Event ev{};
-        ev.ts_ns = now_ns();
-        ev.kind = EV_BLOCK_IO;
-        ev.aux1 = (uint64_t)lat_us;
-        ev.aux2 = (p.bytes << 8) | (p.is_write ? 1 : 0);
-        ev.pid = p.pid;
-        size_t dn = strlen(dev);
-        ev.key_hash = fnv1a64(dev, dn);
-        vocab_.put(ev.key_hash, dev, dn);
-        size_t cn = strlen(p.comm);
-        memcpy(ev.comm, p.comm,
-               cn < sizeof(ev.comm) - 1 ? cn : sizeof(ev.comm) - 1);
-        emit(ev);
-      }
-      inflight_.erase(it);
-    }
-  }
-
-  static std::string key(const char* dev, unsigned long long sector) {
-    char k[48];
-    snprintf(k, sizeof(k), "%s:%llu", dev, sector);
-    return k;
-  }
-
-  static bool write_file(const std::string& path, const char* val) {
-    int fd = open(path.c_str(), O_WRONLY | O_CLOEXEC);
-    if (fd < 0) return false;
-    ssize_t n = write(fd, val, strlen(val));
-    close(fd);
-    return n > 0;
-  }
-
-  void teardown() {
-    if (!made_instance_ || tracefs_.empty()) return;
-    std::string inst = tracefs_ + "/instances/" + instance_;
-    write_file(inst + "/events/block/block_rq_issue/enable", "0");
-    write_file(inst + "/events/block/block_rq_complete/enable", "0");
-    rmdir(inst.c_str());  // removing the instance frees its buffers
-  }
-
-  std::string tracefs_;
-  std::string instance_;
-  bool made_instance_ = false;
-  std::unordered_map<std::string, Pending> inflight_;
-};
-
-// ---------------------------------------------------------------------------
-// CapTraceSource — trace/capabilities via the cap_capable TRACEPOINT.
-//
-// The reference kprobes cap_capable (capable.bpf.c:1-250) to see every
-// capability check on the host with its verdict. Kernels >= 5.17 expose
-// the same function as a real tracepoint (events/capability/cap_capable
-// with cap + ret fields) — the exact mechanism, no BPF: a private tracefs
-// instance enables it and trace_pipe lines carry
-//   comm-pid [cpu] flags ts: cap_capable: cred .., target_ns ..,
-//   capable_ns .., cap 21, ret 0
-// This window sees ALLOWS and DENIES system-wide, strictly stronger than
-// the audit EPERM-rule flavour (denial-only). Events:
-//   kind EV_CAPABILITY   aux1 = 1 allow / 0 deny   aux2 = capability nr
-// ---------------------------------------------------------------------------
-
-class CapTraceSource : public Source {
- public:
-  CapTraceSource(size_t ring_pow2, const std::string& cfg)
-      : Source(ring_pow2) {
-    (void)cfg;
-    static std::atomic<int> seq{0};
-    char inst[64];
-    snprintf(inst, sizeof(inst), "igtpu_cap_%d_%d", (int)getpid(),
-             seq.fetch_add(1));
-    instance_ = inst;
-  }
-  ~CapTraceSource() override {
-    stop();
-    teardown();
-  }
-
-  static bool supported() {
-    std::string root = tracefs_root();
-    if (root.empty()) return false;
-    std::string ev = root + "/events/capability/cap_capable";
-    return access(ev.c_str(), R_OK) == 0;
-  }
-
- protected:
-  void run() override {
-    std::string root = tracefs_root();
-    if (root.empty()) return;
-    std::string inst = root + "/instances/" + instance_;
-    mkdir(inst.c_str(), 0700);
-    if (access(inst.c_str(), R_OK) != 0) return;
-    made_instance_ = true;
-    if (!write_file(inst + "/events/capability/cap_capable/enable", "1"))
-      return;
-    int fd = open((inst + "/trace_pipe").c_str(),
-                  O_RDONLY | O_NONBLOCK | O_CLOEXEC);
-    if (fd < 0) return;
-    struct pollfd pfd{fd, POLLIN, 0};
-    std::string carry;
-    while (running_.load(std::memory_order_relaxed)) {
-      if (poll(&pfd, 1, 100) <= 0) continue;
-      char buf[16384];
-      ssize_t n = read(fd, buf, sizeof(buf));
-      if (n <= 0) continue;
-      carry.append(buf, (size_t)n);
-      size_t pos = 0, nl;
-      while ((nl = carry.find('\n', pos)) != std::string::npos) {
-        parse_line(carry.data() + pos, nl - pos);
-        pos = nl + 1;
-      }
-      carry.erase(0, pos);
-    }
-    close(fd);
-  }
-
- private:
-  void parse_line(const char* line, size_t len) {
-    std::string s(line, len);
-    size_t m = s.find("cap_capable: ");
-    if (m == std::string::npos) return;
-    int cap = -1, ret = 0;
-    size_t cp = s.find("cap ", m);
-    if (cp == std::string::npos ||
-        sscanf(s.c_str() + cp, "cap %d, ret %d", &cap, &ret) != 2 || cap < 0)
-      return;
-    Event ev{};
-    ev.ts_ns = now_ns();
-    ev.kind = EV_CAPABILITY;
-    ev.aux1 = ret == 0 ? 1 : 0;  // allow : deny (ret is -EPERM on denial)
-    ev.aux2 = (uint64_t)cap;
-    // leading "comm-pid" field carries the checking task; it runs up to
-    // the " [cpu]" column, NOT the first space — comms may contain spaces
-    size_t ns_ = s.find_first_not_of(' ');
-    size_t sp = s.find(" [", ns_);
-    if (ns_ != std::string::npos && sp != std::string::npos && sp > ns_) {
-      std::string task = s.substr(ns_, sp - ns_);
-      while (!task.empty() && task.back() == ' ') task.pop_back();
-      size_t dash = task.rfind('-');
-      if (dash != std::string::npos) {
-        ev.pid = (uint32_t)atoi(task.c_str() + dash + 1);
-        std::string comm = task.substr(0, dash);
-        size_t c = comm.size() < sizeof(ev.comm) - 1 ? comm.size()
-                                                     : sizeof(ev.comm) - 1;
-        memcpy(ev.comm, comm.data(), c);
-        ev.key_hash = fnv1a64(comm.data(), comm.size());
-        vocab_.put(ev.key_hash, comm.data(), comm.size());
-      }
-    }
-    if (ev.pid) {
-      char path[64], link[64];
-      snprintf(path, sizeof(path), "/proc/%u/ns/mnt", ev.pid);
-      ssize_t ln = readlink(path, link, sizeof(link) - 1);
-      if (ln > 0) {
-        link[ln] = 0;
-        const char* lb = strchr(link, '[');
-        if (lb) ev.mntns = strtoull(lb + 1, nullptr, 10);
-      }
-    }
-    emit(ev);
-  }
-
-  static bool write_file(const std::string& path, const char* val) {
-    int fd = open(path.c_str(), O_WRONLY | O_CLOEXEC);
-    if (fd < 0) return false;
-    ssize_t n = write(fd, val, strlen(val));
-    close(fd);
-    return n > 0;
-  }
-
-  void teardown() {
-    if (!made_instance_) return;
-    std::string root = tracefs_root();
-    if (root.empty()) return;
-    std::string inst = root + "/instances/" + instance_;
-    write_file(inst + "/events/capability/cap_capable/enable", "0");
-    rmdir(inst.c_str());
-  }
-
-  std::string instance_;
-  bool made_instance_ = false;
-};
 
 }  // namespace ig
 #endif  // __linux__

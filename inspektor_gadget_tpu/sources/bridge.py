@@ -37,6 +37,7 @@ SRC_BLK_TRACE = 111
 SRC_TCP_BYTES = 112
 SRC_AUDIT = 113
 SRC_CAP_TRACE = 114
+SRC_FS_TRACE = 115
 SRC_PKT_DNS = 200
 SRC_PKT_SNI = 201
 SRC_PKT_FLOW = 202
@@ -44,7 +45,7 @@ SRC_PKT_FLOW = 202
 # kinds that take a "key=value\x1f..." config string (create_cfg path)
 _CFG_KINDS = {SRC_FANOTIFY_OPEN, SRC_MOUNTINFO, SRC_SOCK_DIAG, SRC_KMSG_OOM,
               SRC_PTRACE, SRC_FANOTIFY_RUNC, SRC_PERF_CPU, SRC_BLK_TRACE,
-              SRC_TCP_BYTES, SRC_AUDIT, SRC_CAP_TRACE}
+              SRC_TCP_BYTES, SRC_AUDIT, SRC_CAP_TRACE, SRC_FS_TRACE}
 
 
 def make_cfg(**kw) -> str:
@@ -120,6 +121,8 @@ def _load_and_bind(rebuild: bool):
     lib.ig_audit_supported.restype = ctypes.c_int
     lib.ig_captrace_supported.argtypes = []
     lib.ig_captrace_supported.restype = ctypes.c_int
+    lib.ig_fstrace_supported.argtypes = []
+    lib.ig_fstrace_supported.restype = ctypes.c_int
     for fn in ("ig_source_start", "ig_source_stop", "ig_source_destroy"):
         getattr(lib, fn).argtypes = [u64]
         getattr(lib, fn).restype = ctypes.c_int
@@ -201,9 +204,15 @@ def audit_supported() -> bool:
 
 
 def captrace_supported() -> bool:
-    """cap_capable tracepoint window (tracefs, kernel >= 5.17)."""
+    """cap_capable tracepoint window (tracefs, kernel >= 6.7)."""
     lib = _load()
     return lib is not None and bool(lib.ig_captrace_supported())
+
+
+def fstrace_supported() -> bool:
+    """raw_syscalls tracepoint window (host-wide fsslower)."""
+    lib = _load()
+    return lib is not None and bool(lib.ig_fstrace_supported())
 
 
 _SRC_KIND_NAMES = {
@@ -215,7 +224,8 @@ _SRC_KIND_NAMES = {
     SRC_PTRACE: "ptrace", SRC_FANOTIFY_RUNC: "fanotify/runc",
     SRC_PERF_CPU: "perf/cpu", SRC_BLK_TRACE: "blk/trace",
     SRC_TCP_BYTES: "sock_diag/tcpinfo", SRC_AUDIT: "netlink/audit",
-    SRC_CAP_TRACE: "tracefs/cap", SRC_PKT_DNS: "pkt/dns",
+    SRC_CAP_TRACE: "tracefs/cap", SRC_FS_TRACE: "tracefs/fs",
+    SRC_PKT_DNS: "pkt/dns",
     SRC_PKT_SNI: "pkt/sni", SRC_PKT_FLOW: "pkt/flow",
 }
 

@@ -29,7 +29,8 @@ def test_probe_windows_names_and_shape():
     windows = probe_windows()
     expected = {"native_lib", "fanotify", "perf", "kmsg", "ptrace",
                 "sock_diag", "netlink_proc", "af_packet", "mountinfo",
-                "procfs", "blktrace", "tcpinfo", "audit", "captrace"}
+                "procfs", "blktrace", "tcpinfo", "audit", "captrace",
+                "fstrace"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail
@@ -85,12 +86,13 @@ def test_doctor_cli_command():
 
 @needs_native
 @pytest.mark.parametrize("category,name", [
-    ("trace", "fsslower"),
+    ("traceloop", "traceloop"),
 ])
 def test_no_target_ptrace_gadget_fails_loudly(category, name):
-    """fsslower has no host-wide window: a no-target run must error, never
-    fabricate. (capabilities and audit/seccomp gained a host-wide audit
-    flavour and now run targetless — covered in test_gadgets.)"""
+    """traceloop's per-container ring model is inherently per-target: a
+    no-target run must error, never fabricate. (capabilities, fsslower
+    and audit/seccomp gained host-wide tracepoint/audit flavours and now
+    run targetless — covered in test_gadgets.)"""
     desc = get(category, name)
     params = desc.params().to_params()  # source defaults to auto, no target
     ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
@@ -100,6 +102,22 @@ def test_no_target_ptrace_gadget_fails_loudly(category, name):
     assert errs, "no-target ptrace gadget ran without erroring"
     assert "target" in str(errs).lower()
     assert not events, "fabricated events emitted despite the error"
+
+
+@needs_native
+def test_no_target_fsslower_without_window_fails_loudly():
+    """When the host-wide raw_syscalls window is absent too, a no-target
+    fsslower run errors loudly instead of fabricating."""
+    from inspektor_gadget_tpu.sources.bridge import fstrace_supported
+    if fstrace_supported():
+        pytest.skip("fstrace window available — host-wide flavour applies")
+    desc = get("trace", "fsslower")
+    params = desc.params().to_params()
+    ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
+    events = []
+    result = LocalRuntime().run_gadget(ctx, on_event=events.append)
+    assert result.errors()
+    assert not events
 
 
 @needs_native
@@ -226,8 +244,8 @@ def test_container_filter_auto_attach_through_runtime():
 def test_no_selector_means_no_auto_attach():
     """Without a container selector the Attacher gate stays closed: the
     gadget must error loudly, not ptrace every discovered process.
-    (fsslower: the one ptrace gadget with no host-wide audit flavour.)"""
-    desc = get("trace", "fsslower")
+    (traceloop: the one ptrace gadget with no host-wide flavour.)"""
+    desc = get("traceloop", "traceloop")
     params = desc.params().to_params()
     ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
     result = LocalRuntime().run_gadget(ctx)

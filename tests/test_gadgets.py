@@ -227,6 +227,63 @@ def test_audit_seccomp_host_wide_kills():
     assert any(e.syscall == "getpid" for e in kills)
 
 
+def test_trace_fsslower_host_wide():
+    """With no target, trace/fsslower observes real host-wide slow fs ops
+    via filtered raw_syscalls tracepoints (fsslower.bpf.c:1-239 parity:
+    system-wide entry/exit latency above a threshold)."""
+    import subprocess
+    import threading
+
+    from inspektor_gadget_tpu.sources.bridge import fstrace_supported
+    if not fstrace_supported() or os.geteuid() != 0:
+        pytest.skip("raw_syscalls window unavailable")
+
+    stop = threading.Event()
+    fifo = "/tmp/ig_fsslow_fifo"
+    try:
+        os.unlink(fifo)
+    except OSError:
+        pass
+    os.mkfifo(fifo)
+
+    def slow_io():
+        # a fifo whose writer delays guarantees a >=50ms blocking read on
+        # ANY filesystem (dd O_DIRECT tricks fail with EINVAL on tmpfs)
+        time.sleep(0.5)
+        while not stop.is_set():
+            # writer opens the fifo immediately (so the reader's open
+            # returns fast) but delays each WRITE — the slow ops are the
+            # reads, and the second blocking read keeps dd alive while the
+            # first read's exit record resolves its fd path via /proc
+            subprocess.run(
+                ["sh", "-c",
+                 f"( exec 3>{fifo}; sleep 0.08; printf 12345678 >&3; "
+                 f"sleep 0.4; printf 12345678 >&3 ) & "
+                 f"dd if={fifo} of=/dev/null bs=8 count=2; wait"],
+                stderr=subprocess.DEVNULL, check=False)
+            stop.wait(0.15)
+
+    t = threading.Thread(target=slow_io)
+    t.start()
+    try:
+        _, events, _ = run_gadget(
+            "trace", "fsslower", timeout=4.0,
+            param_overrides={"source": "auto", "min-latency": "1"},
+            collect_events=True)
+    finally:
+        stop.set()
+        t.join()
+        try:
+            os.unlink(fifo)
+        except OSError:
+            pass
+    slow = [e for e in events if e is not None and e.latency_us >= 1000]
+    assert slow, [getattr(e, "latency_us", None) for e in events][:10]
+    dd_rows = [e for e in slow if e.comm == "dd" and e.op == "read"]
+    assert dd_rows, [(e.comm, e.op) for e in slow][:10]
+    assert any(e.file == fifo for e in dd_rows)
+
+
 def test_top_file_per_file_rows_under_dd_workload():
     """With the fanotify window, top/file's unit of account is the FILE —
     rows carry real filenames per (pid, file) (filetop.bpf.c:1-108 parity:
