@@ -171,6 +171,30 @@ def _probe_captrace() -> Window:
         return Window("captrace", False, repr(e))
 
 
+def _probe_sockstate() -> Window:
+    # inet_sock_set_state tracepoint — event-driven trace/tcp
+    try:
+        from .sources.bridge import sockstate_supported
+        ok = sockstate_supported()
+        return Window("sockstate", ok,
+                      "inet_sock_set_state tracepoint ok" if ok else
+                      "inet_sock_set_state unavailable (tracefs)")
+    except Exception as e:  # noqa: BLE001
+        return Window("sockstate", False, repr(e))
+
+
+def _probe_sigtrace() -> Window:
+    # signal_generate tracepoint — full sigsnoop parity
+    try:
+        from .sources.bridge import sigtrace_supported
+        ok = sigtrace_supported()
+        return Window("sigtrace", ok,
+                      "signal_generate tracepoint ok" if ok else
+                      "signal_generate unavailable (tracefs)")
+    except Exception as e:  # noqa: BLE001
+        return Window("sigtrace", False, repr(e))
+
+
 def _probe_fstrace() -> Window:
     # raw_syscalls tracepoints with in-kernel id filter (host-wide fsslower)
     try:
@@ -229,7 +253,8 @@ _PROBES = (
     _probe_native_lib, _probe_fanotify, _probe_perf, _probe_kmsg,
     _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
-    _probe_audit, _probe_captrace, _probe_fstrace,
+    _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,
+    _probe_sigtrace,
 )
 
 
@@ -311,6 +336,16 @@ _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
                             "host-wide raw_syscalls entry/exit latency "
                             "with in-kernel fs-syscall filter; ptrace "
                             "flavour per-target"),
+    ("trace", "tcp"): ("sockstate", "procfs",
+                       "event-driven inet_sock_set_state transitions "
+                       "(no scan window); /proc diff scanner fallback"),
+    ("trace", "tcpconnect"): ("sockstate", "procfs",
+                              "connect-only view of the state-transition "
+                              "stream; /proc diff scanner fallback"),
+    ("trace", "signal"): ("sigtrace", "netlink_proc",
+                          "signal_generate tracepoint (every signal, "
+                          "sender+target); netlink-exit fatal-signal "
+                          "fallback; ptrace flavour per-target"),
 }
 
 

@@ -206,8 +206,11 @@ class SignalEvent(_Base):
 
 
 class TraceSignal(_PtraceTargetMixin, SourceTraceGadget):
-    """Native windows: netlink exits (fatal signals, system-wide) by
-    default; the ptrace stream (full sigsnoop semantics) with a target."""
+    """Native windows, fidelity-ordered: the signal_generate TRACEPOINT
+    (the reference's own hook, sigsnoop.bpf.c:1-175 — every signal on the
+    host, sender AND target); netlink exits (fatal signals only) on
+    kernels without tracefs; the ptrace stream with a --command/--pid
+    target (adds the delivery side)."""
 
     native_kind = B.SRC_PROC_EXEC
     synth_kind = B.SRC_SYNTH_EXEC
@@ -221,6 +224,9 @@ class TraceSignal(_PtraceTargetMixin, SourceTraceGadget):
         # the always-True override below
         if _PtraceTargetMixin.native_ready(self):
             self.native_kind = B.SRC_PTRACE
+        elif (self._mode not in ("synthetic", "pysynthetic")
+              and B.sigtrace_supported()):
+            self.native_kind = B.SRC_SIG_TRACE
 
     # netlink mode needs no target; ptrace mode requires one
     def native_ready(self) -> bool:  # noqa: D102

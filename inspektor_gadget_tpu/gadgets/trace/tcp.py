@@ -2,9 +2,16 @@
 
 Reference: pkg/gadgets/trace/tcp (tcptracer.bpf.c kprobes on
 tcp_v4/v6_connect, tcp_close, inet_csk_accept; tracer.go 293 LoC) and
-trace/tcpconnect (tcpconnect.bpf.c). Here one source (native /proc/net/tcp
-diff scanner or synthetic flows) feeds both; tcpconnect is the
-connect-only view.
+trace/tcpconnect (tcpconnect.bpf.c). Two real windows feed both gadgets
+(tcpconnect is the connect-only view):
+
+- **inet_sock_set_state tracepoint** (preferred): every TCP state
+  transition host-wide, event-driven — no scan window, so short-lived
+  connections can't slip between polls. Connect identity comes from the
+  true task context; accept is attributed to the listener via a port→pid
+  map (the transition fires in softirq).
+- **/proc/net/tcp diff scanner** (fallback): polling, with scan-window
+  churn surfaced as drops via SNMP open counters.
 """
 
 from __future__ import annotations
@@ -21,7 +28,8 @@ from ...types import Event, WithMountNsID, WithNetNsID
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
 from ..source_gadget import SourceTraceGadget, source_params
-from ...sources.bridge import SRC_PROC_TCP, SRC_SYNTH_TCP
+from ...sources.bridge import (SRC_PROC_TCP, SRC_SOCK_STATE, SRC_SYNTH_TCP,
+                               sockstate_supported)
 
 _OPS = {4: "connect", 5: "accept", 6: "close"}
 
@@ -48,19 +56,34 @@ def _ip4(addr: int) -> str:
 class TraceTcp(SourceTraceGadget):
     native_kind = SRC_PROC_TCP
     synth_kind = SRC_SYNTH_TCP
-    connect_only = False
+    kind_filter = (4, 5, 6)  # EV_TCP_CONNECT/ACCEPT/CLOSE
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        # explicit synthetic runs must not probe (or build) the native lib
+        if (self._mode not in ("synthetic", "pysynthetic")
+                and sockstate_supported()):
+            self.native_kind = SRC_SOCK_STATE
 
     def decode_row(self, batch, i) -> TcpEvent:
         c = batch.cols
         aux1, aux2 = int(c["aux1"][i]), int(c["aux2"][i])
+        if (aux2 >> 32) & 1:  # v6 flag: aux1 keys "saddr6\x1fdaddr6" vocab
+            pair = self.resolve_key(aux1)
+            saddr, _, daddr = pair.partition("\x1f")
+            ipversion = 6
+        else:
+            saddr, daddr = _ip4(aux1 >> 32), _ip4(aux1 & 0xFFFFFFFF)
+            ipversion = 4
         return TcpEvent(
             timestamp=int(c["ts"][i]),
             mountnsid=int(c["mntns"][i]),
             operation=_OPS.get(int(c["kind"][i]), "unknown"),
             pid=int(c["pid"][i]),
             comm=batch.comm_str(i) or self.resolve_key(int(c["key_hash"][i])),
-            saddr=_ip4(aux1 >> 32),
-            daddr=_ip4(aux1 & 0xFFFFFFFF),
+            ipversion=ipversion,
+            saddr=saddr,
+            daddr=daddr,
             sport=(aux2 >> 16) & 0xFFFF,
             dport=aux2 & 0xFFFF,
         )
@@ -82,7 +105,7 @@ class TraceTcpDesc(GadgetDesc):
 
 
 class TraceTcpConnect(TraceTcp):
-    connect_only = True
+    kind_filter = (4,)  # connect-only view (tcpconnect.bpf.c scope)
 
 
 @register

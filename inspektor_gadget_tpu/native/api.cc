@@ -60,6 +60,8 @@ enum {
   IG_SRC_AUDIT = 113,
   IG_SRC_CAP_TRACE = 114,
   IG_SRC_FS_TRACE = 115,
+  IG_SRC_SOCK_STATE = 116,
+  IG_SRC_SIG_TRACE = 117,
   IG_SRC_PKT_DNS = 200,
   IG_SRC_PKT_SNI = 201,
   IG_SRC_PKT_FLOW = 202,
@@ -170,6 +172,12 @@ uint64_t ig_source_create_cfg(uint32_t kind, const char* cfg,
       break;
     case IG_SRC_FS_TRACE:
       s = new FsTraceSource(cap, c);
+      break;
+    case IG_SRC_SOCK_STATE:
+      s = new SockStateSource(cap, c);
+      break;
+    case IG_SRC_SIG_TRACE:
+      s = new SignalTraceSource(cap, c);
       break;
     default:
       return 0;
@@ -290,6 +298,24 @@ int ig_captrace_supported() {
 int ig_fstrace_supported() {
 #ifdef __linux__
   return FsTraceSource::supported() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// inet_sock_set_state tracepoint window available? (event-driven trace/tcp)
+int ig_sockstate_supported() {
+#ifdef __linux__
+  return SockStateSource::supported() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// signal_generate tracepoint window available? (full sigsnoop parity)
+int ig_sigtrace_supported() {
+#ifdef __linux__
+  return SignalTraceSource::supported() ? 1 : 0;
 #else
   return 0;
 #endif

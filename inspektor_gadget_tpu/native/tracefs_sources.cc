@@ -497,5 +497,269 @@ class CapTraceSource : public TracefsInstanceSource {
   }
 };
 
+// ---------------------------------------------------------------------------
+// SockStateSource — trace/tcp via the inet_sock_set_state TRACEPOINT.
+//
+// The reference kprobes tcp_v4/v6_connect, inet_csk_accept and tcp_close
+// (tcptracer.bpf.c:1-375). The tracepoint window sees every TCP state
+// transition host-wide, event-driven — no scan window, so short-lived
+// connections can't slip between polls like the /proc/net diff scanner's:
+//   inet_sock_set_state: family=AF_INET protocol=IPPROTO_TCP sport=N
+//   dport=M saddr=a.b.c.d daddr=e.f.g.h ... oldstate=X newstate=Y
+// Transition → event mapping (with honest pid attribution — state
+// changes fire in softirq/timer context where the line's task is
+// whatever got interrupted):
+//   CLOSE→SYN_SENT          task context IS the connecting process; the
+//                           tuple lacks sport, so identity is parked and
+//                           EV_TCP_CONNECT emits on SYN_SENT→ESTABLISHED
+//                           with the full tuple
+//   SYN_RECV→ESTABLISHED    EV_TCP_ACCEPT; softirq context — identity is
+//                           the LISTENER, resolved via the port→pid map
+//   ESTABLISHED→FIN_WAIT1 / CLOSE_WAIT→LAST_ACK
+//                           EV_TCP_CLOSE; both fire inside the closing
+//                           process's close() — task context is right
+// Event encoding matches the /proc scanner so the gadget decodes both:
+//   aux1 = saddr_le<<32 | daddr_le     aux2 = sport<<16 | dport
+// ---------------------------------------------------------------------------
+
+class SockStateSource : public TracefsInstanceSource {
+ public:
+  SockStateSource(size_t ring_pow2, const std::string& cfg)
+      : TracefsInstanceSource(ring_pow2, "igtpu_ss") {
+    (void)cfg;
+  }
+  ~SockStateSource() override { stop(); }
+
+  static bool supported() {
+    std::string root = tracefs_root();
+    return root_usable(root) &&
+           access((root + "/events/sock/inet_sock_set_state").c_str(),
+                  R_OK) == 0;
+  }
+
+ protected:
+  std::vector<EventEnable> events() override {
+    enricher_.refresh();  // listener map ready before the first accept
+    last_refresh_ = now_ns();
+    // TCP only; BOTH address families (the /proc fallback scans tcp6 too)
+    return {{"events/sock/inet_sock_set_state", "protocol==6"}};
+  }
+
+  void prune() override {
+    if (pending_connect_.size() > 16384) pending_connect_.clear();
+    uint64_t now = now_ns();
+    if (now - last_refresh_ > 500000000ull) {
+      last_refresh_ = now;
+      enricher_.refresh();
+    }
+  }
+
+  void parse_line(const char* line, size_t len) override {
+    std::string s(line, len);
+    size_t m = s.find("inet_sock_set_state: ");
+    if (m == std::string::npos) return;
+    unsigned sport = 0, dport = 0;
+    char fam[12] = "", saddr[48] = "", daddr[48] = "";
+    char olds[20] = "", news[20] = "";
+    const char* p = s.c_str() + m;
+    if (sscanf(p, "inet_sock_set_state: family=%11s protocol=IPPROTO_TCP"
+                  " sport=%u dport=%u saddr=%47s daddr=%47s",
+               fam, &sport, &dport, saddr, daddr) != 5)
+      return;
+    bool v6 = strcmp(fam, "AF_INET6") == 0;
+    if (v6) {
+      // the dotted fields are mapped-v4 for v6 sockets; use the real ones
+      size_t s6 = s.find("saddrv6=", m), d6 = s.find("daddrv6=", m);
+      if (s6 == std::string::npos || d6 == std::string::npos) return;
+      sscanf(s.c_str() + s6, "saddrv6=%47s", saddr);
+      sscanf(s.c_str() + d6, "daddrv6=%47s", daddr);
+    }
+    size_t os_ = s.find("oldstate=", m);
+    size_t ns2 = s.find("newstate=", m);
+    if (os_ == std::string::npos || ns2 == std::string::npos) return;
+    sscanf(s.c_str() + os_, "oldstate=%19s", olds);
+    sscanf(s.c_str() + ns2, "newstate=%19s", news);
+    std::string comm;
+    uint32_t task_pid = parse_task(s, comm);
+    uint32_t sa = v6 ? 0 : ip4_le(saddr), da = v6 ? 0 : ip4_le(daddr);
+    uint64_t v6key = v6 ? put_v6(saddr, daddr) : 0;
+
+    if (!strcmp(olds, "TCP_CLOSE") && !strcmp(news, "TCP_SYN_SENT")) {
+      // park the connecting task's identity; tuple completes on ESTABLISHED
+      pending_connect_[conn_key(saddr, daddr, dport)] = {task_pid, comm};
+      return;
+    }
+    if (!strcmp(olds, "TCP_SYN_SENT")) {
+      // honest attribution only: a miss means the parked identity is gone
+      // (concurrent connects to the same target, table pruned) — the
+      // line's task here is softirq-interrupted and must NOT be blamed
+      auto it = pending_connect_.find(conn_key(saddr, daddr, dport));
+      uint32_t pid = 0;
+      std::string who;
+      if (it != pending_connect_.end()) {
+        pid = it->second.pid;
+        who = it->second.comm;
+        pending_connect_.erase(it);
+      }
+      if (strcmp(news, "TCP_ESTABLISHED") != 0) return;  // refused/reset
+      push(EV_TCP_CONNECT, pid, who, sa, da, sport, dport, v6, v6key);
+      return;
+    }
+    if (!strcmp(olds, "TCP_SYN_RECV") && !strcmp(news, "TCP_ESTABLISHED")) {
+      uint32_t pid = 0;
+      char owner[32] = "";
+      bool hit = lookup_port_owner(sport, &pid, owner, sizeof(owner));
+      push(EV_TCP_ACCEPT, hit ? pid : 0, hit ? owner : "", sa, da, sport,
+           dport, v6, v6key);
+      return;
+    }
+    // Closes. ESTABLISHED→FIN_WAIT1 and CLOSE_WAIT→LAST_ACK fire inside
+    // the closing process's close() — task context is right. A direct
+    // →TCP_CLOSE from a live state is an abort (RST received, SO_LINGER-0
+    // close, tcp_abort), possibly in softirq — attribute via the port→pid
+    // map instead of blaming the interrupted task.
+    bool task_close =
+        (!strcmp(olds, "TCP_ESTABLISHED") && !strcmp(news, "TCP_FIN_WAIT1"))
+        || (!strcmp(olds, "TCP_CLOSE_WAIT") && !strcmp(news, "TCP_LAST_ACK"));
+    bool abort_close =
+        !strcmp(news, "TCP_CLOSE")
+        && (!strcmp(olds, "TCP_ESTABLISHED")
+            || !strcmp(olds, "TCP_CLOSE_WAIT"));
+    if (task_close) {
+      push(EV_TCP_CLOSE, task_pid, comm, sa, da, sport, dport, v6, v6key);
+    } else if (abort_close) {
+      uint32_t pid = 0;
+      char owner[32] = "";
+      bool hit = lookup_port_owner(sport, &pid, owner, sizeof(owner));
+      push(EV_TCP_CLOSE, hit ? pid : 0, hit ? owner : "", sa, da, sport,
+           dport, v6, v6key);
+    }
+  }
+
+ private:
+  struct PendingConnect {
+    uint32_t pid;
+    std::string comm;
+  };
+
+  // keyed on the ADDRESS STRINGS (works for both families; sport is 0 at
+  // SYN_SENT so it can't participate)
+  static uint64_t conn_key(const char* saddr, const char* daddr,
+                           unsigned dport) {
+    uint64_t h = fnv1a64(saddr, strlen(saddr));
+    h ^= fnv1a64(daddr, strlen(daddr)) * 0x100000001B3ull;
+    return h ^ dport;
+  }
+
+  // dotted quad → the little-endian u32 the /proc scanner emits (the
+  // gadget's decoder unpacks with "<I")
+  static uint32_t ip4_le(const char* dotted) {
+    unsigned a = 0, b = 0, c = 0, d = 0;
+    if (sscanf(dotted, "%u.%u.%u.%u", &a, &b, &c, &d) != 4) return 0;
+    return a | (b << 8) | (c << 16) | (d << 24);
+  }
+
+  // v6 address pair → vocab payload "saddr6\x1fdaddr6" keyed by hash
+  uint64_t put_v6(const char* saddr, const char* daddr) {
+    std::string payload = std::string(saddr) + '\x1f' + daddr;
+    uint64_t h = fnv1a64(payload.data(), payload.size());
+    vocab_.put(h, payload.data(), payload.size());
+    return h;
+  }
+
+  // port → owning process, with a rate-limited refresh on miss (a miss
+  // usually means the socket is younger than the last /proc scan)
+  bool lookup_port_owner(unsigned port, uint32_t* pid, char* owner,
+                         size_t cap) {
+    bool hit = enricher_.lookup((uint16_t)port, pid, owner, cap);
+    if (!hit) {
+      uint64_t now = now_ns();
+      if (now - last_refresh_ > 200000000ull) {
+        last_refresh_ = now;
+        enricher_.refresh();
+        hit = enricher_.lookup((uint16_t)port, pid, owner, cap);
+      }
+    }
+    return hit;
+  }
+
+  void push(uint32_t kind, uint32_t pid, const std::string& comm,
+            uint32_t sa, uint32_t da, unsigned sport, unsigned dport,
+            bool v6, uint64_t v6key) {
+    Event ev{};
+    ev.ts_ns = now_ns();
+    ev.kind = kind;
+    ev.pid = pid;
+    ev.aux1 = v6 ? v6key : (((uint64_t)sa << 32) | da);
+    ev.aux2 = ((uint64_t)(sport & 0xFFFF) << 16) | (dport & 0xFFFF);
+    if (v6) ev.aux2 |= 1ull << 32;  // ipversion flag for the decoder
+    fill_task_identity(ev, comm);
+    emit(ev);
+  }
+
+  SocketEnricher enricher_;
+  uint64_t last_refresh_ = 0;
+  std::unordered_map<uint64_t, PendingConnect> pending_connect_;
+};
+
+// ---------------------------------------------------------------------------
+// SignalTraceSource — trace/signal via the signal_generate TRACEPOINT.
+//
+// The reference's sigsnoop.bpf.c (1-175) hooks the signal_generate
+// tracepoint; this is the same hook, host-wide, covering every signal —
+// not just the fatal ones the netlink-exit window derives:
+//   sig=9 errno=0 code=0 comm=target pid=123 grp=1 res=0
+// The line's task is the SENDER; the record's comm/pid are the TARGET.
+// Encoding matches the gadget: aux1=2 (sent), aux2=sig, pid=sender,
+// ppid=target pid.
+// ---------------------------------------------------------------------------
+
+class SignalTraceSource : public TracefsInstanceSource {
+ public:
+  SignalTraceSource(size_t ring_pow2, const std::string& cfg)
+      : TracefsInstanceSource(ring_pow2, "igtpu_sig") {
+    (void)cfg;
+  }
+  ~SignalTraceSource() override { stop(); }
+
+  static bool supported() {
+    std::string root = tracefs_root();
+    return root_usable(root) &&
+           access((root + "/events/signal/signal_generate").c_str(),
+                  R_OK) == 0;
+  }
+
+ protected:
+  std::vector<EventEnable> events() override {
+    return {{"events/signal/signal_generate", ""}};
+  }
+
+  void parse_line(const char* line, size_t len) override {
+    std::string s(line, len);
+    size_t m = s.find("signal_generate: ");
+    if (m == std::string::npos) return;
+    int sig = 0, res = 0;
+    unsigned tpid = 0;
+    if (sscanf(s.c_str() + m, "signal_generate: sig=%d", &sig) != 1)
+      return;
+    size_t pp = s.find(" pid=", m);
+    if (pp != std::string::npos) sscanf(s.c_str() + pp, " pid=%u", &tpid);
+    size_t rp = s.find(" res=", m);
+    if (rp != std::string::npos) sscanf(s.c_str() + rp, " res=%d", &res);
+    if (sig <= 0) return;
+    std::string comm;
+    uint32_t sender = parse_task(s, comm);
+    Event ev{};
+    ev.ts_ns = now_ns();
+    ev.kind = EV_SIGNAL;
+    ev.pid = sender;
+    ev.ppid = tpid;  // target (the gadget's TPID column)
+    ev.aux1 = 2;     // sent
+    ev.aux2 = (uint64_t)(sig & 0x7F);
+    fill_task_identity(ev, comm);
+    emit(ev);
+  }
+};
+
 }  // namespace ig
 #endif  // __linux__

@@ -38,6 +38,8 @@ SRC_TCP_BYTES = 112
 SRC_AUDIT = 113
 SRC_CAP_TRACE = 114
 SRC_FS_TRACE = 115
+SRC_SOCK_STATE = 116
+SRC_SIG_TRACE = 117
 SRC_PKT_DNS = 200
 SRC_PKT_SNI = 201
 SRC_PKT_FLOW = 202
@@ -45,7 +47,8 @@ SRC_PKT_FLOW = 202
 # kinds that take a "key=value\x1f..." config string (create_cfg path)
 _CFG_KINDS = {SRC_FANOTIFY_OPEN, SRC_MOUNTINFO, SRC_SOCK_DIAG, SRC_KMSG_OOM,
               SRC_PTRACE, SRC_FANOTIFY_RUNC, SRC_PERF_CPU, SRC_BLK_TRACE,
-              SRC_TCP_BYTES, SRC_AUDIT, SRC_CAP_TRACE, SRC_FS_TRACE}
+              SRC_TCP_BYTES, SRC_AUDIT, SRC_CAP_TRACE, SRC_FS_TRACE,
+              SRC_SOCK_STATE, SRC_SIG_TRACE}
 
 
 def make_cfg(**kw) -> str:
@@ -123,6 +126,10 @@ def _load_and_bind(rebuild: bool):
     lib.ig_captrace_supported.restype = ctypes.c_int
     lib.ig_fstrace_supported.argtypes = []
     lib.ig_fstrace_supported.restype = ctypes.c_int
+    lib.ig_sockstate_supported.argtypes = []
+    lib.ig_sockstate_supported.restype = ctypes.c_int
+    lib.ig_sigtrace_supported.argtypes = []
+    lib.ig_sigtrace_supported.restype = ctypes.c_int
     for fn in ("ig_source_start", "ig_source_stop", "ig_source_destroy"):
         getattr(lib, fn).argtypes = [u64]
         getattr(lib, fn).restype = ctypes.c_int
@@ -215,6 +222,18 @@ def fstrace_supported() -> bool:
     return lib is not None and bool(lib.ig_fstrace_supported())
 
 
+def sockstate_supported() -> bool:
+    """inet_sock_set_state tracepoint (event-driven trace/tcp)."""
+    lib = _load()
+    return lib is not None and bool(lib.ig_sockstate_supported())
+
+
+def sigtrace_supported() -> bool:
+    """signal_generate tracepoint (full sigsnoop parity)."""
+    lib = _load()
+    return lib is not None and bool(lib.ig_sigtrace_supported())
+
+
 _SRC_KIND_NAMES = {
     SRC_SYNTH_EXEC: "synth/exec", SRC_SYNTH_TCP: "synth/tcp",
     SRC_SYNTH_DNS: "synth/dns", SRC_PROC_EXEC: "netlink/proc",
@@ -225,6 +244,7 @@ _SRC_KIND_NAMES = {
     SRC_PERF_CPU: "perf/cpu", SRC_BLK_TRACE: "blk/trace",
     SRC_TCP_BYTES: "sock_diag/tcpinfo", SRC_AUDIT: "netlink/audit",
     SRC_CAP_TRACE: "tracefs/cap", SRC_FS_TRACE: "tracefs/fs",
+    SRC_SOCK_STATE: "tracefs/sock", SRC_SIG_TRACE: "tracefs/signal",
     SRC_PKT_DNS: "pkt/dns",
     SRC_PKT_SNI: "pkt/sni", SRC_PKT_FLOW: "pkt/flow",
 }

@@ -227,6 +227,119 @@ def test_audit_seccomp_host_wide_kills():
     assert any(e.syscall == "getpid" for e in kills)
 
 
+def test_trace_tcp_event_driven_state_transitions():
+    """With the inet_sock_set_state window, trace/tcp reports real
+    connect/accept/close events with tuple and pid attribution — no scan
+    window (tcptracer.bpf.c:1-375 parity)."""
+    import socket
+    import threading
+
+    from inspektor_gadget_tpu.sources.bridge import sockstate_supported
+    if not sockstate_supported() or os.geteuid() != 0:
+        pytest.skip("inet_sock_set_state window unavailable")
+
+    port_box = {}
+    stop = threading.Event()
+
+    def workload():
+        time.sleep(0.8)
+        ls = socket.socket()
+        ls.bind(("127.0.0.1", 0))
+        ls.listen(4)
+        port_box["port"] = ls.getsockname()[1]
+        def srv():
+            while not stop.is_set():
+                try:
+                    ls.settimeout(0.5)
+                    conn, _ = ls.accept()
+                    conn.close()
+                except OSError:
+                    pass
+        st = threading.Thread(target=srv)
+        st.start()
+        while not stop.is_set():
+            try:
+                cs = socket.create_connection(
+                    ("127.0.0.1", port_box["port"]), timeout=1.0)
+                cs.close()
+            except OSError:
+                pass
+            stop.wait(0.25)
+        st.join()
+        ls.close()
+
+    t = threading.Thread(target=workload)
+    t.start()
+    try:
+        _, events, _ = run_gadget(
+            "trace", "tcp", timeout=4.0,
+            param_overrides={"source": "native"}, collect_events=True)
+        # connect-only view against the same live workload: the kind
+        # filter must drop the accept/close transitions
+        _, cevents, _ = run_gadget(
+            "trace", "tcpconnect", timeout=2.0,
+            param_overrides={"source": "native"}, collect_events=True)
+    finally:
+        stop.set()
+        t.join()
+    port = port_box.get("port")
+    mine = [e for e in events
+            if e is not None and port in (e.sport, e.dport)]
+    ops = {e.operation for e in mine}
+    assert {"connect", "accept", "close"} <= ops, (port, ops)
+    connects = [e for e in mine if e.operation == "connect"]
+    # kubeipresolver may suffix a label onto addresses ("127.0.0.1 (host)")
+    assert all(e.daddr.startswith("127.0.0.1") and e.dport == port
+               for e in connects)
+    assert any(e.pid > 0 and e.comm for e in connects)
+    cmine = [e for e in cevents if e is not None]
+    assert cmine and all(e.operation == "connect" for e in cmine)
+
+
+def test_trace_signal_host_wide_tracepoint():
+    """With the signal_generate window, trace/signal reports every signal
+    host-wide with sender and target (sigsnoop.bpf.c:1-175 parity) — not
+    just fatal exits."""
+    import signal as sig_mod
+    import subprocess
+    import threading
+
+    from inspektor_gadget_tpu.sources.bridge import sigtrace_supported
+    if not sigtrace_supported() or os.geteuid() != 0:
+        pytest.skip("signal_generate window unavailable")
+
+    stop = threading.Event()
+    victim = subprocess.Popen(["sleep", "30"])
+
+    def trigger():
+        time.sleep(0.8)
+        while not stop.is_set():
+            os.kill(victim.pid, sig_mod.SIGUSR2)  # non-fatal... for sleep
+            stop.wait(0.25)
+
+    t = threading.Thread(target=trigger)
+    t.start()
+    try:
+        _, events, _ = run_gadget(
+            "trace", "signal", timeout=3.0,
+            param_overrides={"source": "native"}, collect_events=True)
+    finally:
+        stop.set()
+        t.join()
+        victim.kill()
+        victim.wait()
+    # SIGUSR2 kills sleep (default action term) — either way the GENERATE
+    # event must carry sender (this process) and target (the sleep pid)
+    mine = [e for e in events
+            if e is not None and e.tpid == victim.pid and e.origin == "sent"]
+    assert mine, [(getattr(e, "tpid", None), getattr(e, "origin", None))
+                  for e in events][:10]
+    # the sender pid in the trace line is the sending THREAD's tid (the
+    # trigger runs in a pytest worker thread), so assert attribution
+    # exists rather than equality with the process pid
+    assert any(e.pid > 0 and e.comm for e in mine)
+
+
 def test_trace_fsslower_host_wide():
     """With no target, trace/fsslower observes real host-wide slow fs ops
     via filtered raw_syscalls tracepoints (fsslower.bpf.c:1-239 parity:
