@@ -68,7 +68,9 @@ class TraceTcp(SourceTraceGadget):
     def decode_row(self, batch, i) -> TcpEvent:
         c = batch.cols
         aux1, aux2 = int(c["aux1"][i]), int(c["aux2"][i])
-        if (aux2 >> 32) & 1:  # v6 flag: aux1 keys "saddr6\x1fdaddr6" vocab
+        # v6 flag rides bit 48 — bits 32-35 carry the /proc fallback's
+        # TCP state and must not be mistaken for it
+        if (aux2 >> 48) & 1:  # aux1 keys "saddr6\x1fdaddr6" in the vocab
             pair = self.resolve_key(aux1)
             saddr, _, daddr = pair.partition("\x1f")
             ipversion = 6

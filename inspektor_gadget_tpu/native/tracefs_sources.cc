@@ -585,8 +585,16 @@ class SockStateSource : public TracefsInstanceSource {
     uint64_t v6key = v6 ? put_v6(saddr, daddr) : 0;
 
     if (!strcmp(olds, "TCP_CLOSE") && !strcmp(news, "TCP_SYN_SENT")) {
-      // park the connecting task's identity; tuple completes on ESTABLISHED
-      pending_connect_[conn_key(saddr, daddr, dport)] = {task_pid, comm};
+      // Park the connecting task's identity; tuple completes on
+      // ESTABLISHED. sport is 0 here, so concurrent connects to the same
+      // target share a key — a collision from a DIFFERENT task makes the
+      // slot ambiguous (pid 0 for both beats blaming the wrong process).
+      uint64_t key = conn_key(saddr, daddr, dport);
+      auto it = pending_connect_.find(key);
+      if (it != pending_connect_.end() && it->second.pid != task_pid)
+        it->second = {0, ""};
+      else
+        pending_connect_[key] = {task_pid, comm};
       return;
     }
     if (!strcmp(olds, "TCP_SYN_SENT")) {
@@ -692,7 +700,9 @@ class SockStateSource : public TracefsInstanceSource {
     ev.pid = pid;
     ev.aux1 = v6 ? v6key : (((uint64_t)sa << 32) | da);
     ev.aux2 = ((uint64_t)(sport & 0xFFFF) << 16) | (dport & 0xFFFF);
-    if (v6) ev.aux2 |= 1ull << 32;  // ipversion flag for the decoder
+    // ipversion flag for the decoder — bit 48, clear of the /proc
+    // fallback's state field (sources.cc packs state<<32, values <= 12)
+    if (v6) ev.aux2 |= 1ull << 48;
     fill_task_identity(ev, comm);
     emit(ev);
   }

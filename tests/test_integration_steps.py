@@ -152,6 +152,135 @@ def test_expected_regexp_and_string():
         ])
 
 
+needs_root = pytest.mark.skipif(os.geteuid() != 0, reason="needs root")
+
+
+def _window(name: str) -> bool:
+    from inspektor_gadget_tpu.sources import bridge
+    fn = getattr(bridge, name, None)
+    return bool(fn and fn())
+
+
+@needs_root
+def test_trace_tcp_host_wide_steps():
+    """e2e tier for the event-driven tcp window: CLI subprocess + live
+    loopback workload + JSON entry match (ref: integration
+    trace_tcp_test.go shape)."""
+    if not _window("sockstate_supported"):
+        pytest.skip("inet_sock_set_state window unavailable")
+    import socket as socklib
+    import threading
+
+    box = {}
+
+    def workload():
+        # the CLI subprocess needs several seconds to boot (jax import)
+        # before it captures; keep connecting across that window
+        ls = socklib.socket()
+        ls.bind(("127.0.0.1", 0))
+        ls.listen(8)
+        box["port"] = ls.getsockname()[1]
+        stop = threading.Event()
+
+        def srv():
+            while not stop.is_set():
+                try:
+                    ls.settimeout(0.5)
+                    conn, _ = ls.accept()
+                    conn.close()
+                except OSError:
+                    pass
+        t = threading.Thread(target=srv)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                cs = socklib.create_connection(("127.0.0.1", box["port"]),
+                                               timeout=1.0)
+                cs.close()
+            except OSError:
+                pass
+            time.sleep(0.4)
+        stop.set()
+        t.join()
+        ls.close()
+        time.sleep(0.5)
+
+    def normalize(e: dict) -> None:
+        for k in ("timestamp", "pid", "mountnsid", "netnsid", "comm",
+                  "saddr", "daddr", "sport"):
+            e.pop(k, None)
+        # entries from other connections on the host are irrelevant
+        if e.get("dport") != box.get("port"):
+            e.clear()
+            e["skip"] = True
+
+    def check(output: str) -> None:
+        expect_entries_to_match(
+            output, normalize,
+            {"operation": "connect", "ipversion": 4,
+             "dport": box["port"], "type": "normal",
+             **build_common_data()})
+
+    run_test_steps([
+        Command(name="trace-tcp",
+                cmd=ig_cli("trace", "tcp", "--source", "native",
+                           "-o", "json"),
+                start_and_stop=True,
+                expected_output_fn=check),
+        FuncStep(name="workload", fn=workload),
+    ], step_wait=1.0)
+
+
+@needs_root
+def test_trace_capabilities_host_wide_steps():
+    """e2e tier for the host-wide capability window: CLI subprocess +
+    unprivileged chown workload + JSON entry match."""
+    if not (_window("captrace_supported") or _window("audit_supported")):
+        pytest.skip("no host-wide capability window")
+    target = "/tmp/ig_step_cap"
+
+    def workload():
+        # span the CLI subprocess's slow boot (jax import) with triggers
+        open(target, "w").close()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            subprocess.run(
+                ["setpriv", "--reuid", "65534", "--clear-groups",
+                 "chown", "0:0", target],
+                check=False, stderr=subprocess.DEVNULL)
+            time.sleep(0.4)
+        time.sleep(0.5)
+
+    def normalize(e: dict) -> None:
+        for k in ("timestamp", "pid", "uid", "mountnsid", "comm",
+                  "audit"):
+            e.pop(k, None)
+        if not (e.get("cap") == "CHOWN" and e.get("verdict") == "deny"):
+            e.clear()
+            e["skip"] = True
+
+    def check(output: str) -> None:
+        expect_entries_to_match(
+            output, normalize,
+            {"cap": "CHOWN", "verdict": "deny", "type": "normal",
+             **build_common_data()})
+
+    try:
+        run_test_steps([
+            Command(name="trace-capabilities",
+                    cmd=ig_cli("trace", "capabilities", "-o", "json"),
+                    start_and_stop=True,
+                    expected_output_fn=check),
+            FuncStep(name="workload", fn=workload),
+        ], step_wait=1.0)
+    finally:
+        try:
+            os.unlink(target)
+        except OSError:
+            pass
+
+
 def test_profile_cpu_json_output():
     r = subprocess.run(ig_cli("profile", "cpu", "--timeout", "1",
                               "-o", "json"),
