@@ -38,6 +38,13 @@ def source_params() -> ParamDescs:
     ])
 
 
+def container_key(container) -> str:
+    """The one key attach and detach must agree on, or detached sources
+    leak (prefer the runtime id; a bare pid for fake/test containers)."""
+    return (getattr(container, "id", "")
+            or str(getattr(container, "pid", 0)))
+
+
 class PtraceAttachMixin:
     """Attacher implementation for ptrace-window gadgets: a container
     filter auto-attaches the syscall stream to each matching container's
@@ -53,14 +60,11 @@ class PtraceAttachMixin:
     attach_replaces_main = True
 
     def attach_container(self, container) -> None:
-        key = (getattr(container, "id", "")
-               or str(getattr(container, "pid", 0)))
-        self._attach_ptrace_pid(int(getattr(container, "pid", 0)), key)
+        self._attach_ptrace_pid(int(getattr(container, "pid", 0)),
+                                container_key(container))
 
     def detach_container(self, container) -> None:
-        key = (getattr(container, "id", "")
-               or str(getattr(container, "pid", 0)))
-        self._detach_key(key)
+        self._detach_key(container_key(container))
 
 
 class SourceTraceGadget:
@@ -219,21 +223,27 @@ class SourceTraceGadget:
 
     # per-container attach (ref: localmanager.go:230-260 Attacher path) -----
 
-    def _attach_ptrace_pid(self, pid: int, key: str) -> None:
-        """Attach a ptrace capture to an existing pid (a container's init
-        process); the run loop pops it alongside the main source."""
-        from ..sources.bridge import SRC_PTRACE
-        if pid <= 0:
-            raise ValueError(f"attach needs a live pid, got {pid}")
-        src = NativeCapture(SRC_PTRACE, ring_pow2=18,
-                            batch_size=self._batch_size,
-                            cfg=B_make_cfg(pid=pid))
+    def _attach_native_source(self, key: str, kind: int, cfg: str,
+                              ring_pow2: int = 18) -> None:
+        """Attach any native capture keyed to a container; the run loop
+        pops it alongside the main source (ref: localmanager.go:230-260
+        per-container attach)."""
+        src = NativeCapture(kind, ring_pow2=ring_pow2,
+                            batch_size=self._batch_size, cfg=cfg)
         src.start()
         with self._attach_lock:
             old = self._attach_sources.get(key)
             self._attach_sources[key] = src
         if old is not None:  # re-attach for the same key: retire the old one
             self._retire(old)
+
+    def _attach_ptrace_pid(self, pid: int, key: str) -> None:
+        """Attach a ptrace capture to an existing pid (a container's init
+        process)."""
+        from ..sources.bridge import SRC_PTRACE
+        if pid <= 0:
+            raise ValueError(f"attach needs a live pid, got {pid}")
+        self._attach_native_source(key, SRC_PTRACE, B_make_cfg(pid=pid))
 
     def _retire(self, src) -> None:
         """Stop a source but defer freeing: the run loop may hold its handle

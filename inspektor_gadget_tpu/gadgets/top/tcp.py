@@ -30,6 +30,7 @@ from ..interface import GadgetDesc, GadgetType
 from ..interval_gadget import IntervalGadget, interval_params
 from ..registry import register
 from ..source_gadget import SourceTraceGadget, source_params
+from ..source_gadget import container_key
 from ...sources.bridge import (SRC_PROC_TCP, SRC_SYNTH_TCP, SRC_TCP_BYTES,
                                make_cfg, native_available, tcpinfo_supported)
 
@@ -47,7 +48,32 @@ class TcpTopStats(Event, WithMountNsID):
 
 
 class _TcpFeed(SourceTraceGadget):
+    """Feed for TopTcp. Attacher role: the host-netns sock_diag dump can't
+    see a container's private netns, so a container selector attaches one
+    byte source per matching container whose capture thread setns()es into
+    that container's netns (TcpBytesSource netns_pid cfg) — the per-netns
+    flavour the docs promise."""
+
     synth_kind = SRC_SYNTH_TCP
+    # netns-entering byte sources are cheap, but attaching to every
+    # procfs-discovered process would still be noise: selector-gated
+    attach_requires_selector = True
+
+    def attach_container(self, container) -> None:
+        pid = int(getattr(container, "pid", 0))
+        if pid <= 0:
+            raise ValueError(f"attach needs a live pid, got {pid}")
+        if not self._bytes_mode:
+            # degraded kernel (no INET_DIAG_INFO): the churn main source
+            # keeps running mntns-filtered — don't replace it with nothing
+            raise RuntimeError("per-netns top/tcp needs the INET_DIAG_INFO "
+                               "window; falling back to churn rows")
+        self._attach_native_source(
+            container_key(container), SRC_TCP_BYTES,
+            make_cfg(interval_ms=self._poll_ms, netns_pid=pid))
+
+    def detach_container(self, container) -> None:
+        self._detach_key(container_key(container))
 
     def __init__(self, ctx, interval_s: float = 1.0):
         super().__init__(ctx)
@@ -61,6 +87,10 @@ class _TcpFeed(SourceTraceGadget):
             self._bytes_mode = native_available() and tcpinfo_supported()
             self.native_kind = (SRC_TCP_BYTES if self._bytes_mode
                                 else SRC_PROC_TCP)
+        # per-container netns sources replace the host view ONLY when the
+        # byte window exists; in degraded mode attaches fail (warned) and
+        # the churn main source must keep running
+        self.attach_replaces_main = self._bytes_mode
         # poll at half the drain interval (bounded) so each drain sees at
         # least one fresh delta per active connection
         self._poll_ms = max(100, min(int(interval_s * 500), 1000))
@@ -77,6 +107,11 @@ class _TcpFeed(SourceTraceGadget):
 
 
 class TopTcp(IntervalGadget):
+    # Attacher protocol, delegated to the feed (the localmanager operates
+    # on this gadget instance, the feed owns the sources)
+    attach_requires_selector = True
+    attach_pending = False
+
     def __init__(self, ctx):
         super().__init__(ctx)
         self._feed = _TcpFeed(ctx, interval_s=self.interval)
@@ -84,8 +119,28 @@ class TopTcp(IntervalGadget):
         self._stats: dict[tuple, list] = {}
         self._thread: threading.Thread | None = None
 
+    @property
+    def _mode(self):  # localmanager's synthetic-run attach gate
+        return self._feed._mode
+
     def set_mntns_filter(self, mntns_ids) -> None:
         self._feed.set_mntns_filter(mntns_ids)
+
+    def attach_container(self, container) -> None:
+        self._feed.attach_pending = True
+        self._feed.attach_container(container)
+
+    def detach_container(self, container) -> None:
+        self._feed.detach_container(container)
+
+    def __setattr__(self, name, value):
+        # forward the localmanager's attach_pending flag to the feed (it
+        # decides whether a main source is created) — but never suppress
+        # the degraded churn source on kernels without the byte window
+        if (name == "attach_pending" and hasattr(self, "_feed")
+                and self._feed.bytes_mode):
+            self._feed.attach_pending = value
+        super().__setattr__(name, value)
 
     def setup(self, ctx) -> None:
         if self._feed._mode in ("synthetic", "pysynthetic"):

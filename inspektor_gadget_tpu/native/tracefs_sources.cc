@@ -588,26 +588,31 @@ class SockStateSource : public TracefsInstanceSource {
       // Park the connecting task's identity; tuple completes on
       // ESTABLISHED. sport is 0 here, so concurrent connects to the same
       // target share a key — a collision from a DIFFERENT task makes the
-      // slot ambiguous (pid 0 for both beats blaming the wrong process).
+      // slot ambiguous (pid 0 beats blaming the wrong process), and the
+      // ambiguity must outlive the FIRST establishment (a refcount, not a
+      // flag): with it erased early, a third connect re-parking would be
+      // blamed for the second's connection.
       uint64_t key = conn_key(saddr, daddr, dport);
       auto it = pending_connect_.find(key);
-      if (it != pending_connect_.end() && it->second.pid != task_pid)
-        it->second = {0, ""};
-      else
-        pending_connect_[key] = {task_pid, comm};
+      if (it == pending_connect_.end()) {
+        pending_connect_[key] = {task_pid, comm, 1};
+      } else {
+        it->second.count++;
+        if (it->second.pid != task_pid) it->second = {0, "", it->second.count};
+      }
       return;
     }
     if (!strcmp(olds, "TCP_SYN_SENT")) {
       // honest attribution only: a miss means the parked identity is gone
-      // (concurrent connects to the same target, table pruned) — the
-      // line's task here is softirq-interrupted and must NOT be blamed
+      // (table pruned) — the line's task here is softirq-interrupted and
+      // must NOT be blamed
       auto it = pending_connect_.find(conn_key(saddr, daddr, dport));
       uint32_t pid = 0;
       std::string who;
       if (it != pending_connect_.end()) {
         pid = it->second.pid;
         who = it->second.comm;
-        pending_connect_.erase(it);
+        if (--it->second.count <= 0) pending_connect_.erase(it);
       }
       if (strcmp(news, "TCP_ESTABLISHED") != 0) return;  // refused/reset
       push(EV_TCP_CONNECT, pid, who, sa, da, sport, dport, v6, v6key);
@@ -648,6 +653,7 @@ class SockStateSource : public TracefsInstanceSource {
   struct PendingConnect {
     uint32_t pid;
     std::string comm;
+    int count;  // concurrent connects sharing this key (sport is 0)
   };
 
   // keyed on the ADDRESS STRINGS (works for both families; sport is 0 at

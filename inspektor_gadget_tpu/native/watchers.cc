@@ -22,6 +22,7 @@
 #ifdef __linux__
 #include <fcntl.h>
 #include <poll.h>
+#include <sched.h>
 #include <sys/fanotify.h>
 #include <sys/mount.h>
 #include <sys/stat.h>
@@ -459,6 +460,11 @@ class TcpBytesSource : public Source {
       : Source(ring_pow2) {
     interval_ms_ = atoi(cfg_get(cfg, "interval_ms", "500").c_str());
     if (interval_ms_ <= 0) interval_ms_ = 500;
+    // The sock_diag dump is netns-scoped; a container with a private
+    // netns needs its own source whose capture THREAD enters that netns
+    // (setns is per-thread, the rawsock/netnsenter contract) before
+    // dumping — the per-container Attacher path passes the init pid here.
+    netns_pid_ = atoi(cfg_get(cfg, "netns_pid", "0").c_str());
   }
   ~TcpBytesSource() override { stop(); }
 
@@ -538,6 +544,26 @@ class TcpBytesSource : public Source {
   };
 
   void run() override {
+    if (netns_pid_ > 0) {
+      char path[64];
+      snprintf(path, sizeof(path), "/proc/%d/ns/net", netns_pid_);
+      int nfd = open(path, O_RDONLY | O_CLOEXEC);
+      if (nfd < 0) {
+        // distinguishable in agent logs: EPERM is a capability problem,
+        // ENOENT means the container is simply gone
+        fprintf(stderr, "igcapture: tcp-bytes netns open %s failed: %s\n",
+                path, strerror(errno));
+        return;
+      }
+      int rc = setns(nfd, CLONE_NEWNET);
+      close(nfd);
+      if (rc != 0) {
+        fprintf(stderr,
+                "igcapture: tcp-bytes setns(pid %d) failed: %s "
+                "(needs CAP_SYS_ADMIN)\n", netns_pid_, strerror(errno));
+        return;
+      }
+    }
     bool first = true;
     while (running_.load(std::memory_order_relaxed)) {
       for (auto& [inode, c] : conns_) c.seen = false;
@@ -717,6 +743,7 @@ class TcpBytesSource : public Source {
   }
 
   int interval_ms_;
+  int netns_pid_ = 0;
   std::unordered_map<uint64_t, ConnState> conns_;
   std::unordered_map<uint64_t, std::pair<uint64_t, uint64_t>> pending_;
 };

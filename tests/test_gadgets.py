@@ -453,6 +453,60 @@ def test_top_file_procio_flavour_still_works():
     assert arrays  # ticks emitted; rows may be empty on an idle host
 
 
+def test_top_tcp_per_netns_container_attach():
+    """A container with a private netns is invisible to the host-netns
+    sock_diag dump; the Attacher path spawns a per-container byte source
+    whose capture thread setns()es into the container's netns (the
+    per-netns flavour the docs promise)."""
+    import shutil
+    import subprocess
+
+    from inspektor_gadget_tpu.sources.bridge import tcpinfo_supported
+    if (not tcpinfo_supported() or os.geteuid() != 0
+            or not shutil.which("unshare") or not shutil.which("ip")):
+        pytest.skip("netns tooling or INET_DIAG_INFO unavailable")
+
+    import sys
+    child = subprocess.Popen(
+        ["unshare", "-n", "bash", "-c",
+         f"ip link set lo up && {sys.executable} -c \"\n"
+         "import socket, threading, time\n"
+         "ls = socket.socket(); ls.bind(('127.0.0.1', 41998)); ls.listen(1)\n"
+         "def srv():\n"
+         "    conn, _ = ls.accept()\n"
+         "    while conn.recv(65536): pass\n"
+         "t = threading.Thread(target=srv); t.start()\n"
+         "time.sleep(2.5)\n"
+         "cs = socket.create_connection(('127.0.0.1', 41998))\n"
+         "for _ in range(48): cs.sendall(b'x'*65536); time.sleep(0.03)\n"
+         "time.sleep(2.0); cs.close(); t.join()\n"
+         "\""])
+    try:
+        time.sleep(1.0)
+        desc = get("top", "tcp")
+        params = desc.params().to_params()
+        ctx = GadgetContext(desc, gadget_params=params, timeout=6.0)
+        g = desc.new_instance(ctx)
+
+        class _C:
+            id = "netns-probe"
+            pid = child.pid
+        g.attach_container(_C())
+        arrays = []
+        g.set_event_handler_array(arrays.append)
+        import threading
+        threading.Thread(target=ctx.wait_for_timeout_or_done,
+                         daemon=True).start()  # the runtime's timeout role
+        g.run(ctx)
+        rows = [r for tick in arrays for r in tick]
+        mine = [r for r in rows if ":41998" in r.conn]
+        assert mine, sorted({r.conn for r in rows})[:10]
+        assert sum(r.sent for r in mine) > 1 << 20
+    finally:
+        child.kill()
+        child.wait()
+
+
 def test_top_tcp_real_bytes_under_live_workload():
     """With the INET_DIAG_INFO window, top/tcp reports real per-connection
     SENT/RECV byte counts (tcptop.bpf.c:1-133 parity: kprobe byte sums →
