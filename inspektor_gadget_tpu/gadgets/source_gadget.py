@@ -223,12 +223,13 @@ class SourceTraceGadget:
 
     # per-container attach (ref: localmanager.go:230-260 Attacher path) -----
 
-    def _attach_native_source(self, key: str, kind: int, cfg: str,
-                              ring_pow2: int = 18) -> None:
+    def _attach_native_source(self, key: str, kind: int, cfg: str = "",
+                              ring_pow2: int = 18, seed: int = 0) -> None:
         """Attach any native capture keyed to a container; the run loop
         pops it alongside the main source (ref: localmanager.go:230-260
-        per-container attach)."""
-        src = NativeCapture(kind, ring_pow2=ring_pow2,
+        per-container attach). seed carries the netns fd for packet
+        sources (numeric-create kinds)."""
+        src = NativeCapture(kind, ring_pow2=ring_pow2, seed=seed,
                             batch_size=self._batch_size, cfg=cfg)
         src.start()
         with self._attach_lock:

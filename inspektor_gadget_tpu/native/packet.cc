@@ -46,10 +46,15 @@ enum PacketKindFilter : uint32_t {
 class SocketEnricher {
  public:
   void refresh() {
-    // inode -> port from /proc/net/{tcp,udp}
+    // inode -> port from the CALLING THREAD's netns view: /proc/net is a
+    // symlink to /proc/self/net (the main process's netns), which would
+    // read the HOST socket table from a capture thread that setns()'d
+    // into a container — /proc/thread-self/net follows the thread
     std::unordered_map<uint64_t, uint16_t> inode_port;
-    for (const char* path : {"/proc/net/tcp", "/proc/net/udp",
-                             "/proc/net/tcp6", "/proc/net/udp6"}) {
+    for (const char* path : {"/proc/thread-self/net/tcp",
+                             "/proc/thread-self/net/udp",
+                             "/proc/thread-self/net/tcp6",
+                             "/proc/thread-self/net/udp6"}) {
       FILE* f = fopen(path, "r");
       if (!f) continue;
       char line[512];

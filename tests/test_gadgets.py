@@ -453,6 +453,56 @@ def test_top_file_procio_flavour_still_works():
     assert arrays  # ticks emitted; rows may be empty on an idle host
 
 
+def test_trace_dns_per_netns_container_attach():
+    """A DNS query inside a container's private netns is invisible to the
+    host-netns sniffer; the Attacher path opens one sniffer per container
+    netns (networktracer/tracer.go:54-220 parity: one refcounted
+    attachment per netns)."""
+    import shutil
+    import subprocess
+    import sys
+    import threading
+
+    from inspektor_gadget_tpu.sources.bridge import native_available
+    if (not native_available() or os.geteuid() != 0
+            or not shutil.which("unshare") or not shutil.which("ip")):
+        pytest.skip("netns tooling unavailable")
+
+    child = subprocess.Popen(
+        ["unshare", "-n", "bash", "-c",
+         f"ip link set lo up && {sys.executable} -c \"\n"
+         "import socket, struct, time\n"
+         "time.sleep(2.0)\n"
+         "q = struct.pack('>HHHHHH', 0x1234, 0x0100, 1, 0, 0, 0)\n"
+         "q += b'\\x07netnsgd\\x07example\\x03com\\x00'"
+         " + struct.pack('>HH', 1, 1)\n"
+         "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+         "for _ in range(30):\n"
+         "    s.sendto(q, ('127.0.0.1', 53)); time.sleep(0.15)\n"
+         "\""])
+    try:
+        time.sleep(0.5)
+        desc = get("trace", "dns")
+        params = desc.params().to_params()
+        ctx = GadgetContext(desc, gadget_params=params, timeout=6.0)
+        g = desc.new_instance(ctx)
+
+        class _C:
+            id = "dns-netns"
+            pid = child.pid
+        g.attach_container(_C())
+        events = []
+        g.set_event_handler(events.append)
+        threading.Thread(target=ctx.wait_for_timeout_or_done,
+                         daemon=True).start()
+        g.run(ctx)
+    finally:
+        child.kill()
+        child.wait()
+    names = {e.name for e in events if e is not None}
+    assert any("netnsgd" in n for n in names), sorted(names)[:10]
+
+
 def test_top_tcp_per_netns_container_attach():
     """A container with a private netns is invisible to the host-netns
     sock_diag dump; the Attacher path spawns a per-container byte source
