@@ -1,9 +1,12 @@
-"""snapshot/socket — one-shot socket listing.
+"""snapshot/socket — one-shot socket listing, per netns.
 
 Reference: pkg/gadgets/snapshot/socket (BPF socket iterators
-tcp4-collector.c/udp4-collector.c). Procfs analogue: parse
-/proc/net/{tcp,tcp6,udp,udp6} — same rows (proto, local, remote, state,
-inode), protocol filter param mirrored.
+tcp4-collector.c/udp4-collector.c, run once per container netns via
+netnsenter). Procfs analogue: parse /proc/net/{tcp,tcp6,udp,udp6} for the
+host view PLUS each tracked container's /proc/<pid>/net — the same files
+through that process's netns, no setns needed — deduped by netns inode
+(pod containers share one view). Same rows (proto, local, remote, state,
+inode) with container/netns identity; protocol filter param mirrored.
 """
 
 from __future__ import annotations
@@ -53,7 +56,8 @@ def _decode_addr6(hexstr: str) -> tuple[str, int]:
     return ip, int(port, 16)
 
 
-def _parse(path: str, proto: str, v6: bool) -> list[SocketEvent]:
+def _parse(path: str, proto: str, v6: bool,
+           container: str = "", netnsid: int = 0) -> list[SocketEvent]:
     rows = []
     try:
         with open(path) as f:
@@ -73,10 +77,53 @@ def _parse(path: str, proto: str, v6: bool) -> list[SocketEvent]:
                 rows.append(SocketEvent(protocol=proto, localaddr=la,
                                         localport=lp, remoteaddr=ra,
                                         remoteport=rp, status=status,
-                                        inode=inode))
+                                        inode=inode, container=container,
+                                        netnsid=netnsid))
     except OSError:
         pass
     return rows
+
+
+def _netns_views() -> list[tuple[str, str, int]]:
+    """(proc net root, container label, netns id) per distinct netns: the
+    host view plus each tracked container's /proc/<pid>/net (which
+    reflects THAT process's netns — the BPF-iterator-per-netns role of
+    the reference's collector, netnsenter-free). Containers sharing the
+    host's or another container's netns are deduped by inode."""
+    import os
+
+    host_ino = 0
+    try:
+        host_ino = os.stat("/proc/self/ns/net").st_ino
+    except OSError:
+        pass
+    views = [("/proc/net", "", host_ino)]
+    seen = {host_ino}
+    try:
+        from ...operators.operators import get as get_op
+        lm = get_op("localmanager")
+        containers = list(lm.cc.get_all()) if lm.cc is not None else []
+    except Exception:  # collection not initialized — host-only snapshot
+        containers = []
+    for c in containers:
+        pid = getattr(c, "pid", 0)
+        if pid <= 0:
+            continue
+        # the collection's linux-ns enrichment already stamped the netns
+        # inode at add time; stat only when that option wasn't active
+        ino = getattr(c, "netns", 0)
+        if not ino:
+            try:
+                ino = os.stat(f"/proc/{pid}/ns/net").st_ino
+            except OSError:
+                continue  # container gone mid-snapshot
+        if ino in seen:
+            continue
+        seen.add(ino)
+        views.append((f"/proc/{pid}/net",
+                      getattr(c, "name", "") or getattr(c, "id", "")[:12],
+                      ino))
+    return views
 
 
 class SnapshotSocket:
@@ -90,12 +137,13 @@ class SnapshotSocket:
 
     def run_with_result(self, ctx) -> bytes:
         rows: list[SocketEvent] = []
-        if self.proto in ("all", "tcp"):
-            rows += _parse("/proc/net/tcp", "tcp", False)
-            rows += _parse("/proc/net/tcp6", "tcp", True)
-        if self.proto in ("all", "udp"):
-            rows += _parse("/proc/net/udp", "udp", False)
-            rows += _parse("/proc/net/udp6", "udp", True)
+        for root, cname, netnsid in _netns_views():
+            if self.proto in ("all", "tcp"):
+                rows += _parse(f"{root}/tcp", "tcp", False, cname, netnsid)
+                rows += _parse(f"{root}/tcp6", "tcp", True, cname, netnsid)
+            if self.proto in ("all", "udp"):
+                rows += _parse(f"{root}/udp", "udp", False, cname, netnsid)
+                rows += _parse(f"{root}/udp6", "udp", True, cname, netnsid)
         ctx.result = rows
         if self._array_handler is not None:
             self._array_handler(rows)

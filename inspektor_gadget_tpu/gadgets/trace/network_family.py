@@ -72,7 +72,8 @@ class _NetnsAttachMixin:
         # attach can't leave a phantom entry whose detach would tear down
         # someone else's sniffer. The native source takes ownership of the
         # fd (closes it at destroy) — the rawsock contract.
-        fd = os.open(path, os.O_RDONLY)
+        from ...utils.netns import netns_fd_for_pid
+        fd = netns_fd_for_pid(pid)
         try:
             self._attach_native_source(f"netns-{ino}", self.native_kind,
                                        seed=fd)
@@ -94,7 +95,13 @@ class _NetnsAttachMixin:
             if refs[ino] > 0:
                 return
             del refs[ino]
-        self._detach_key(f"netns-{ino}")
+            # pop the source under the SAME lock as the refcount delete: a
+            # concurrent attach for this netns after the lock releases must
+            # see neither refs nor the old source, else its fresh sniffer
+            # would be the one retired here
+            src = self._attach_sources.pop(f"netns-{ino}", None)
+        if src is not None:
+            self._retire(src)
 
 _QTYPES = {1: "A", 28: "AAAA", 5: "CNAME", 15: "MX", 16: "TXT", 12: "PTR",
            2: "NS", 6: "SOA", 33: "SRV"}

@@ -453,6 +453,58 @@ def test_top_file_procio_flavour_still_works():
     assert arrays  # ticks emitted; rows may be empty on an idle host
 
 
+def test_snapshot_socket_covers_container_netns():
+    """snapshot/socket lists sockets of tracked containers' private netns
+    too (the reference iterates per container netns), via each pid's
+    /proc/<pid>/net view — with container identity on the rows."""
+    import shutil
+    import subprocess
+    import sys
+
+    if (os.geteuid() != 0 or not shutil.which("unshare")
+            or not shutil.which("ip")):
+        pytest.skip("netns tooling unavailable")
+
+    from inspektor_gadget_tpu.containers import Container
+    from inspektor_gadget_tpu.operators.operators import ensure_initialized
+
+    # -S skips site processing: this image's sitecustomize pre-imports
+    # jax, which would delay the listener by seconds
+    child = subprocess.Popen(
+        ["unshare", "-n", "bash", "-c",
+         f"ip link set lo up && {sys.executable} -S -c \"\n"
+         "import socket, time\n"
+         "ls = socket.socket(); ls.bind(('127.0.0.1', 46123)); ls.listen(1)\n"
+         "time.sleep(20)\n"
+         "\""])
+    lm = ensure_initialized("localmanager")
+    cid = "netns-snap-probe"
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:  # wait for the bind, not a guess
+            try:
+                if "B42B" in open(f"/proc/{child.pid}/net/tcp").read():
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        lm.cc.add_container(Container(id=cid, name="snap-probe",
+                                      pid=child.pid))
+        _, _, arrays = run_gadget("snapshot", "socket", timeout=0.5,
+                                  param_overrides={"proto": "tcp"},
+                                  collect_arrays=True)
+    finally:
+        lm.cc.remove_container(cid)
+        child.kill()
+        child.wait()
+    rows = [r for tick in arrays for r in tick]
+    mine = [r for r in rows if r.localport == 46123]
+    assert mine, f"container-netns LISTEN socket missing " \
+                 f"({len(rows)} rows total)"
+    assert any(r.container == "snap-probe" and r.status == "LISTEN"
+               and r.netnsid > 0 for r in mine)
+
+
 def test_trace_dns_per_netns_container_attach():
     """A DNS query inside a container's private netns is invisible to the
     host-netns sniffer; the Attacher path opens one sniffer per container
