@@ -84,7 +84,7 @@ def _parse(path: str, proto: str, v6: bool,
     return rows
 
 
-def _netns_views() -> list[tuple[str, str, int]]:
+def _netns_views(selector=None) -> list[tuple[str, str, int]]:
     """(proc net root, container label, netns id) per distinct netns: the
     host view plus each tracked container's /proc/<pid>/net (which
     reflects THAT process's netns — the BPF-iterator-per-netns role of
@@ -102,7 +102,8 @@ def _netns_views() -> list[tuple[str, str, int]]:
     try:
         from ...operators.operators import get as get_op
         lm = get_op("localmanager")
-        containers = list(lm.cc.get_all()) if lm.cc is not None else []
+        containers = (list(lm.cc.get_all(selector))
+                      if lm.cc is not None else [])
     except Exception:  # collection not initialized — host-only snapshot
         containers = []
     for c in containers:
@@ -136,8 +137,20 @@ class SnapshotSocket:
         self._array_handler = handler
 
     def run_with_result(self, ctx) -> bytes:
+        # honor the run's container selector (operator.localmanager.
+        # containername) — an unselected run lists every tracked netns
+        selector = None
+        try:
+            lp = ctx.operator_params.get("operator.localmanager.")
+            sel_name = (lp.get("containername").as_string()
+                        if lp is not None and "containername" in lp else "")
+            if sel_name:
+                from ...containers import ContainerSelector
+                selector = ContainerSelector(name=sel_name)
+        except Exception:
+            pass
         rows: list[SocketEvent] = []
-        for root, cname, netnsid in _netns_views():
+        for root, cname, netnsid in _netns_views(selector):
             if self.proto in ("all", "tcp"):
                 rows += _parse(f"{root}/tcp", "tcp", False, cname, netnsid)
                 rows += _parse(f"{root}/tcp6", "tcp", True, cname, netnsid)

@@ -61,6 +61,11 @@ def _fanotify_window_available() -> bool:
 
 
 class TopFile(IntervalGadget):
+    # light per-container mount marks (the host "/" mark can't see
+    # container overlay mounts), no selector gate needed
+    attach_requires_selector = False
+    attach_pending = False
+
     def __init__(self, ctx):
         super().__init__(ctx)
         p = ctx.gadget_params
@@ -70,16 +75,33 @@ class TopFile(IntervalGadget):
                        if "paths" in p else "/")
         self._mntns_filter: set[int] | None = None
         self._src = None
-        self._mode = ""
+        # the capture window is decided HERE, not in setup(): the
+        # localmanager attaches containers before run() reaches setup(),
+        # and attach_container must know whether fanotify applies. (Named
+        # _window_mode, not _mode — the localmanager's synthetic-run gate
+        # reads gadget._mode with source-param semantics.)
+        if (self._window in ("auto", "fanotify")
+                and _fanotify_window_available()):
+            self._window_mode = "fanotify"
+        elif self._window == "fanotify":
+            raise RuntimeError("top/file: fanotify window unavailable "
+                               "(needs CAP_SYS_ADMIN and the native lib)")
+        else:
+            self._window_mode = "procio"
+        import threading
+        self._attach_lock = threading.Lock()
+        self._attach_srcs: dict[str, object] = {}
+        self._retired: list = []
 
     def set_mntns_filter(self, mntns_ids) -> None:
         self._mntns_filter = mntns_ids
-        if self._src is not None:
-            self._src.set_filter(mntns_ids)
+        with self._attach_lock:
+            extras = list(self._attach_srcs.values())
+        for src in ([self._src] if self._src is not None else []) + extras:
+            src.set_filter(mntns_ids)
 
     def setup(self, ctx) -> None:
-        want = self._window
-        if want in ("auto", "fanotify") and _fanotify_window_available():
+        if self._window_mode == "fanotify":
             from ...sources.bridge import (NativeCapture, SRC_FANOTIFY_OPEN,
                                            make_cfg)
             self._src = NativeCapture(
@@ -88,52 +110,99 @@ class TopFile(IntervalGadget):
             if self._mntns_filter is not None:
                 self._src.set_filter(self._mntns_filter)
             self._src.start()
-            self._mode = "fanotify"
             ctx.logger.info("top/file: fanotify window — per-(pid,file) "
                             "rows with real filenames")
             return
-        if want == "fanotify":
-            raise RuntimeError("top/file: fanotify window unavailable "
-                               "(needs CAP_SYS_ADMIN and the native lib)")
-        self._mode = "procio"
         ctx.logger.info("top/file: DEGRADED procio window — per-process "
                         "/proc/<pid>/io deltas, no FILE column")
         self._prev: dict[int, tuple] = {}
         self._comm: dict[int, str] = {}
 
     def teardown(self, ctx) -> None:
-        if self._src is not None:
+        with self._attach_lock:
+            extras = list(self._attach_srcs.values()) + self._retired
+            self._attach_srcs.clear()
+            self._retired = []
+        for src in ([self._src] if self._src is not None else []) + extras:
             try:
-                self._src.stop()
-                self._src.close()
+                src.stop()
+                src.close()
             except Exception:
                 pass
-            self._src = None
+        self._src = None
+
+    # per-container mount marks (same role as trace/open's
+    # _MountAttachMixin; TopFile owns its sources directly) -----------------
+
+    def attach_container(self, container) -> None:
+        import os
+
+        from ..source_gadget import container_key
+        from ...sources.bridge import (NativeCapture, SRC_FANOTIFY_OPEN,
+                                       make_cfg)
+        pid = int(getattr(container, "pid", 0))
+        if pid <= 0:
+            raise ValueError(f"attach needs a live pid, got {pid}")
+        if self._window_mode != "fanotify":
+            raise RuntimeError("per-container top/file needs the fanotify "
+                               "window")
+        if os.stat(f"/proc/{pid}/ns/mnt").st_ino == \
+                os.stat("/proc/self/ns/mnt").st_ino:
+            return  # the main "/" mark already covers our own mount ns
+        key = container_key(container)
+        src = NativeCapture(SRC_FANOTIFY_OPEN, ring_pow2=18,
+                            batch_size=8192,
+                            cfg=make_cfg(paths=f"/proc/{pid}/root",
+                                         modify=1))
+        if self._mntns_filter is not None:
+            src.set_filter(self._mntns_filter)
+        src.start()
+        with self._attach_lock:
+            old = self._attach_srcs.get(key)
+            self._attach_srcs[key] = src
+        if old is not None:
+            old.stop()
+            with self._attach_lock:
+                self._retired.append(old)
+
+    def detach_container(self, container) -> None:
+        from ..source_gadget import container_key
+        with self._attach_lock:
+            src = self._attach_srcs.pop(container_key(container), None)
+        if src is not None:
+            # the collect loop may hold the handle mid-pop: stop now,
+            # free at teardown
+            src.stop()
+            with self._attach_lock:
+                self._retired.append(src)
 
     # fanotify flavour ------------------------------------------------------
 
     def _collect_fanotify(self) -> list[FileStats]:
-        # key: (pid, path_hash) → [opens, writes, comm, mntns]
+        # key: (pid, path_hash) → [opens, writes, comm, mntns, source]
         stats: dict[tuple, list] = {}
-        src = self._src
-        while True:
-            batch = src.pop()
-            if batch.count == 0:
-                break
-            c = batch.cols
-            for i in range(batch.count):
-                key = (int(c["pid"][i]), int(c["aux1"][i]))
-                ent = stats.get(key)
-                if ent is None:
-                    stats[key] = ent = [0, 0, batch.comm_str(i),
-                                        int(c["mntns"][i])]
-                bits = int(c["aux2"][i])
-                if bits & 1:
-                    ent[0] += 1
-                if bits & 2:
-                    ent[1] += 1
+        with self._attach_lock:
+            extras = list(self._attach_srcs.values())
+        sources = ([self._src] if self._src is not None else []) + extras
+        for src in sources:
+            while True:
+                batch = src.pop()
+                if batch.count == 0:
+                    break
+                c = batch.cols
+                for i in range(batch.count):
+                    key = (int(c["pid"][i]), int(c["aux1"][i]))
+                    ent = stats.get(key)
+                    if ent is None:
+                        stats[key] = ent = [0, 0, batch.comm_str(i),
+                                            int(c["mntns"][i]), src]
+                    bits = int(c["aux2"][i])
+                    if bits & 1:
+                        ent[0] += 1
+                    if bits & 2:
+                        ent[1] += 1
         rows = []
-        for (pid, ph), (opens, writes, comm, mntns) in stats.items():
+        for (pid, ph), (opens, writes, comm, mntns, src) in stats.items():
             path = src.vocab_lookup(ph) or f"0x{ph:016x}"
             rows.append(FileStats(pid=pid, comm=comm, file=path,
                                   reads=opens, writes=writes,
@@ -189,7 +258,7 @@ class TopFile(IntervalGadget):
         return rows
 
     def collect(self, ctx) -> list[FileStats]:
-        if self._mode == "fanotify":
+        if self._window_mode == "fanotify":
             return self._collect_fanotify()
         return self._collect_procio()
 

@@ -25,6 +25,7 @@ on the event kind so fabricated rows are never presented as captures.
 from __future__ import annotations
 
 import dataclasses
+import os
 import shlex
 
 import numpy as np
@@ -34,8 +35,36 @@ from ...params import ParamDesc, ParamDescs, TypeHint
 from ...types import Event, WithMountNsID
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
-from ..source_gadget import PtraceAttachMixin, SourceTraceGadget, source_params
+from ..source_gadget import (PtraceAttachMixin, SourceTraceGadget,
+                             container_key, source_params)
 from ...sources import bridge as B
+
+
+class _MountAttachMixin:
+    """Per-container fanotify attach: a mount mark on "/" covers only the
+    HOST root mount — container overlay roots are separate mounts whose
+    opens it never sees. Each discovered container gets its own fanotify
+    source marking /proc/<pid>/root (the container's root mount, reachable
+    without entering the mount ns). Containers sharing our mount ns are
+    no-ops — the main mark already covers them (and procfs-discovered
+    host processes would re-mark the host root)."""
+
+    attach_requires_selector = False
+    attach_replaces_main = False
+
+    def attach_container(self, container) -> None:
+        pid = int(getattr(container, "pid", 0))
+        if pid <= 0:
+            raise ValueError(f"attach needs a live pid, got {pid}")
+        if os.stat(f"/proc/{pid}/ns/mnt").st_ino == \
+                os.stat("/proc/self/ns/mnt").st_ino:
+            return
+        self._attach_native_source(
+            container_key(container), B.SRC_FANOTIFY_OPEN,
+            cfg=B.make_cfg(paths=f"/proc/{pid}/root", modify=1))
+
+    def detach_container(self, container) -> None:
+        self._detach_key(container_key(container))
 
 # EventKind values (native/events.h)
 EV_OPEN, EV_BIND, EV_SIGNAL, EV_MOUNT, EV_OOMKILL = 3, 8, 9, 10, 11
@@ -119,7 +148,7 @@ class OpenEvent(_Base):
     path: str = col("", width=32, ellipsis="start")
 
 
-class TraceOpen(SourceTraceGadget):
+class TraceOpen(_MountAttachMixin, SourceTraceGadget):
     native_kind = B.SRC_FANOTIFY_OPEN
     synth_kind = B.SRC_SYNTH_EXEC
     kind_filter = (EV_OPEN,)

@@ -453,6 +453,59 @@ def test_top_file_procio_flavour_still_works():
     assert arrays  # ticks emitted; rows may be empty on an idle host
 
 
+def test_trace_open_per_container_mount_attach():
+    """Opens on a container's private mounts are invisible to the host "/"
+    mount mark; the Attacher path marks the container's root mount via
+    /proc/<pid>/root, capturing them with resolved paths."""
+    import shutil
+    import subprocess
+    import threading
+
+    from inspektor_gadget_tpu.gadgets.top.file import (
+        _fanotify_window_available,
+    )
+    if (not _fanotify_window_available() or os.geteuid() != 0
+            or not shutil.which("unshare")):
+        pytest.skip("fanotify/netns tooling unavailable")
+
+    # writes land on the container's ROOT mount (a private clone of the
+    # host root vfsmount — the host "/" mark does not see accesses through
+    # it); container submounts/volumes are a documented limitation
+    child = subprocess.Popen(
+        ["unshare", "-m", "bash", "-c",
+         "for i in $(seq 1 60); do echo hi > /ig_attach_open_$i; "
+         "sleep 0.1; done; rm -f /ig_attach_open_*"])
+    try:
+        time.sleep(0.8)
+        desc = get("trace", "open")
+        ctx = GadgetContext(desc, gadget_params=desc.params().to_params(),
+                            timeout=4.0)
+        g = desc.new_instance(ctx)
+
+        class _C:
+            id = "open-mnt-probe"
+            pid = child.pid
+        g.attach_container(_C())
+        events = []
+        g.set_event_handler(events.append)
+        threading.Thread(target=ctx.wait_for_timeout_or_done,
+                         daemon=True).start()
+        g.run(ctx)
+    finally:
+        child.kill()
+        child.wait()
+        import glob
+        for leftover in glob.glob("/ig_attach_open_*"):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
+    mine = [e for e in events
+            if e is not None and "ig_attach_open_" in e.path]
+    assert mine, sorted({e.path for e in events if e is not None})[:10]
+    assert any(e.op == "write" and e.pid > 0 for e in mine)
+
+
 def test_snapshot_socket_covers_container_netns():
     """snapshot/socket lists sockets of tracked containers' private netns
     too (the reference iterates per container netns), via each pid's
