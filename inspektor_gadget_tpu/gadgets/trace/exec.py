@@ -28,7 +28,7 @@ class ExecEvent(Event, WithMountNsID):
     uid: int = col(0, template="uid", dtype=np.int32)
     comm: str = col("", template="comm")
     retval: int = col(0, width=4, dtype=np.int32)
-    args: str = col("", width=40, hide=True)
+    args: str = col("", width=40, ellipsis="end")  # execsnoop's ARGS column
 
 
 class TraceExec(SourceTraceGadget):
@@ -38,6 +38,11 @@ class TraceExec(SourceTraceGadget):
 
     def decode_row(self, batch, i) -> ExecEvent:
         c = batch.cols
+        # aux1 keys the full argv in the vocab (EV_EXEC only; EV_EXIT's
+        # aux fields carry the exit code)
+        args = ""
+        if int(c["kind"][i]) == 1 and int(c["aux1"][i]):
+            args = self.resolve_key(int(c["aux1"][i]))
         return ExecEvent(
             timestamp=int(c["ts"][i]),
             mountnsid=int(c["mntns"][i]),
@@ -46,6 +51,7 @@ class TraceExec(SourceTraceGadget):
             uid=int(c["uid"][i]),
             comm=batch.comm_str(i) or self.resolve_key(int(c["key_hash"][i])),
             retval=0,
+            args=args,
         )
 
 

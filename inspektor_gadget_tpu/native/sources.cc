@@ -16,6 +16,7 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <cerrno>
@@ -401,6 +402,51 @@ class ProcExecSource : public Source {
       vocab_.put(ev.key_hash, buf, (size_t)n);
       memcpy(ev.comm, buf, (size_t)n < sizeof(ev.comm) - 1 ? (size_t)n
                                                            : sizeof(ev.comm) - 1);
+    }
+    // ppid + real uid: execsnoop's columns (the BPF event carries them
+    // from task_struct; here one /proc/<pid>/status read — NOT the
+    // /proc/<pid> inode owner, which the kernel forces to root for
+    // non-dumpable processes, i.e. every setuid exec). Best effort — an
+    // exec-and-exit racer may already be gone.
+    char path[64];
+    snprintf(path, sizeof(path), "/proc/%u/status", pid);
+    int fd = open(path, O_RDONLY);
+    if (fd >= 0) {
+      char sb[1024];
+      ssize_t n = read(fd, sb, sizeof(sb) - 1);
+      close(fd);
+      if (n > 0) {
+        sb[n] = 0;
+        const char* pp = strstr(sb, "\nPPid:");
+        unsigned v = 0;
+        if (pp && sscanf(pp + 6, " %u", &v) == 1) ev.ppid = v;
+        const char* up = strstr(sb, "\nUid:");
+        if (up && sscanf(up + 5, " %u", &v) == 1) ev.uid = v;  // real uid
+      }
+    }
+    // argv: /proc/<pid>/cmdline, NUL-separated → spaces, vocab under aux1
+    // (execsnoop's ARGS column; tracer.go:169-181 parses the same buffer,
+    // itself capped in-kernel). A line beyond the buffer is marked "..."
+    // so truncation is visible and distinct commands can't silently
+    // collapse onto a shared prefix hash.
+    snprintf(path, sizeof(path), "/proc/%u/cmdline", pid);
+    fd = open(path, O_RDONLY);
+    if (fd >= 0) {
+      char ab[2048];
+      ssize_t n = read(fd, ab, sizeof(ab) - 1);
+      close(fd);
+      bool truncated = n == (ssize_t)sizeof(ab) - 1;
+      while (n > 0 && ab[n - 1] == 0) n--;  // trailing NUL(s)
+      if (n > 0) {
+        for (ssize_t i = 0; i < n; i++)
+          if (ab[i] == 0) ab[i] = ' ';
+        if (truncated && n <= (ssize_t)sizeof(ab) - 4) {
+          memcpy(ab + n, "...", 3);
+          n += 3;
+        }
+        ev.aux1 = fnv1a64(ab, (size_t)n);
+        vocab_.put(ev.aux1, ab, (size_t)n);
+      }
     }
   }
 

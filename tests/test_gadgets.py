@@ -137,6 +137,43 @@ def test_profile_blockio_per_io_distribution():
     assert sum(counts) >= 100, result.decode()
 
 
+def test_trace_exec_args_and_ppid():
+    """The native exec window carries execsnoop's headline columns: ARGS
+    (full argv) and PPID, enriched at capture time (tracer.go:169-181
+    parses the same buffer from the BPF event)."""
+    import subprocess
+    import threading
+
+    from inspektor_gadget_tpu.sources.bridge import native_available
+    if not native_available() or os.geteuid() != 0:
+        pytest.skip("native exec window unavailable")
+
+    stop = threading.Event()
+
+    def workload():
+        time.sleep(0.6)
+        while not stop.is_set():
+            # the unusual duration doubles as the argv marker; the 130ms
+            # lifetime guarantees the capture thread's /proc/cmdline read
+            # wins the race (an instantly-exiting `true` can lose it)
+            subprocess.run(["sleep", "0.137"], check=False)
+            stop.wait(0.1)
+
+    t = threading.Thread(target=workload)
+    t.start()
+    try:
+        _, events, _ = run_gadget(
+            "trace", "exec", timeout=3.0,
+            param_overrides={"source": "native"}, collect_events=True)
+    finally:
+        stop.set()
+        t.join()
+    mine = [e for e in events
+            if e is not None and e.args == "sleep 0.137"]
+    assert mine, [e.args for e in events if e is not None and e.args][:10]
+    assert any(e.ppid == os.getpid() for e in mine)
+
+
 def _audit_window_available():
     from inspektor_gadget_tpu.sources.bridge import audit_supported
     return audit_supported()
