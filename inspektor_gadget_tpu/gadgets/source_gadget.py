@@ -45,6 +45,85 @@ def container_key(container) -> str:
             or str(getattr(container, "pid", 0)))
 
 
+class NsRefcountAttachMixin:
+    """Per-container attach with ONE source per distinct namespace (ref:
+    networktracer/tracer.go:54-220's refcounted per-netns attachments).
+    Pod containers sharing a namespace map onto one attachment; containers
+    in the gadget's own namespace are no-ops (the main source covers them,
+    and procfs-discovered host processes would otherwise re-attach the
+    host view). Subclasses set attach_ns ("net"/"mnt") and implement
+    _ns_source_args(pid) -> (kind, cfg, seed) — seed carries a netns fd
+    for packet sources, 0 otherwise. All state is mutated under
+    _attach_lock: discovery pumps publish add/remove from several threads,
+    and the source pop happens under the SAME lock as the refcount delete
+    so a concurrent attach can never have its fresh source retired by an
+    in-flight detach."""
+
+    attach_ns = "net"
+    attach_requires_selector = False
+    attach_replaces_main = False
+
+    def _ns_source_args(self, pid: int) -> tuple[int, str, int]:
+        raise NotImplementedError
+
+    def _ns_attach_state(self):
+        if not hasattr(self, "_ns_refs"):
+            import os
+            self._ns_refs = {}        # ns inode -> refcount
+            self._container_ns = {}   # container key -> ns inode
+            self._self_ns = os.stat(
+                f"/proc/self/ns/{self.attach_ns}").st_ino
+        return self._ns_refs, self._container_ns
+
+    def attach_container(self, container) -> None:
+        import os
+        pid = int(getattr(container, "pid", 0))
+        if pid <= 0:
+            raise ValueError(f"attach needs a live pid, got {pid}")
+        ino = os.stat(f"/proc/{pid}/ns/{self.attach_ns}").st_ino
+        ckey = container_key(container)
+        with self._attach_lock:
+            refs, by_container = self._ns_attach_state()
+            if ino == self._self_ns:
+                return
+            if ino in refs:
+                refs[ino] += 1
+                by_container[ckey] = ino
+                return
+        # slow path outside the lock (fd open + native create); the
+        # mapping is recorded only AFTER the ref is taken, so a failed
+        # attach can't leave a phantom entry whose detach would tear
+        # down someone else's source
+        kind, cfg, seed = self._ns_source_args(pid)
+        try:
+            self._attach_native_source(
+                f"{self.attach_ns}ns-{ino}", kind, cfg=cfg, seed=seed)
+        except Exception:
+            if seed:
+                import os as _os
+                _os.close(seed)
+            raise
+        with self._attach_lock:
+            refs, by_container = self._ns_attach_state()
+            refs[ino] = refs.get(ino, 0) + 1
+            by_container[ckey] = ino
+
+    def detach_container(self, container) -> None:
+        with self._attach_lock:
+            refs, by_container = self._ns_attach_state()
+            ino = by_container.pop(container_key(container), None)
+            if ino is None or ino not in refs:
+                return
+            refs[ino] -= 1
+            if refs[ino] > 0:
+                return
+            del refs[ino]
+            src = self._attach_sources.pop(f"{self.attach_ns}ns-{ino}",
+                                           None)
+        if src is not None:
+            self._retire(src)
+
+
 class PtraceAttachMixin:
     """Attacher implementation for ptrace-window gadgets: a container
     filter auto-attaches the syscall stream to each matching container's

@@ -17,7 +17,6 @@ streams cover test/bench paths.
 from __future__ import annotations
 
 import dataclasses
-import os
 
 import numpy as np
 
@@ -26,82 +25,22 @@ from ...params import ParamDescs
 from ...types import Event, WithNetNsID
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
-from ..source_gadget import SourceTraceGadget, container_key, source_params
+from ..source_gadget import (NsRefcountAttachMixin, SourceTraceGadget,
+                             source_params)
 from ...sources import bridge as B
 
 
-class _NetnsAttachMixin:
-    """Per-container netns attach for the packet family (ref:
-    networktracer/tracer.go:54-220 — ONE refcounted attachment per netns;
-    pods share a netns, so containers map onto attachments many-to-one).
-    A container in the gadget's own netns is a no-op — the main sniffer
-    already covers it (and procfs-discovered host processes would
-    otherwise each try to re-attach the host netns)."""
+class _NetnsAttachMixin(NsRefcountAttachMixin):
+    """Per-container netns sniffers for the packet family: one refcounted
+    AF_PACKET source per distinct netns, its thread setns()'d into the
+    container (the native source takes ownership of the fd — the rawsock
+    contract)."""
 
-    # light attach (a raw socket per netns), so no selector gate — the
-    # reference attaches every matching container's netns too
-    attach_requires_selector = False
-    attach_replaces_main = False
+    attach_ns = "net"
 
-    def _netns_state(self):
-        # under _attach_lock: discovery pumps (netlink, fanotify, pod
-        # informer) publish add/remove from their own threads
-        if not hasattr(self, "_netns_refs"):
-            self._netns_refs = {}       # netns inode -> refcount
-            self._container_netns = {}  # container key -> netns inode
-            self._self_netns = os.stat("/proc/self/ns/net").st_ino
-        return self._netns_refs, self._container_netns
-
-    def attach_container(self, container) -> None:
-        pid = int(getattr(container, "pid", 0))
-        if pid <= 0:
-            raise ValueError(f"attach needs a live pid, got {pid}")
-        path = f"/proc/{pid}/ns/net"
-        ino = os.stat(path).st_ino
-        ckey = container_key(container)
-        with self._attach_lock:
-            refs, by_container = self._netns_state()
-            if ino == self._self_netns:
-                return  # the main sniffer already covers our own netns
-            if ino in refs:
-                refs[ino] += 1
-                by_container[ckey] = ino
-                return
-        # slow path outside the lock (fd open + native create); the
-        # mapping is recorded only AFTER the ref is taken, so a failed
-        # attach can't leave a phantom entry whose detach would tear down
-        # someone else's sniffer. The native source takes ownership of the
-        # fd (closes it at destroy) — the rawsock contract.
+    def _ns_source_args(self, pid: int):
         from ...utils.netns import netns_fd_for_pid
-        fd = netns_fd_for_pid(pid)
-        try:
-            self._attach_native_source(f"netns-{ino}", self.native_kind,
-                                       seed=fd)
-        except Exception:
-            os.close(fd)
-            raise
-        with self._attach_lock:
-            refs, by_container = self._netns_state()
-            refs[ino] = refs.get(ino, 0) + 1
-            by_container[ckey] = ino
-
-    def detach_container(self, container) -> None:
-        with self._attach_lock:
-            refs, by_container = self._netns_state()
-            ino = by_container.pop(container_key(container), None)
-            if ino is None or ino not in refs:
-                return
-            refs[ino] -= 1
-            if refs[ino] > 0:
-                return
-            del refs[ino]
-            # pop the source under the SAME lock as the refcount delete: a
-            # concurrent attach for this netns after the lock releases must
-            # see neither refs nor the old source, else its fresh sniffer
-            # would be the one retired here
-            src = self._attach_sources.pop(f"netns-{ino}", None)
-        if src is not None:
-            self._retire(src)
+        return self.native_kind, "", netns_fd_for_pid(pid)
 
 _QTYPES = {1: "A", 28: "AAAA", 5: "CNAME", 15: "MX", 16: "TXT", 12: "PTR",
            2: "NS", 6: "SOA", 33: "SRV"}

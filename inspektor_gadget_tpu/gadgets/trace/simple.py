@@ -25,7 +25,6 @@ on the event kind so fabricated rows are never presented as captures.
 from __future__ import annotations
 
 import dataclasses
-import os
 import shlex
 
 import numpy as np
@@ -35,36 +34,24 @@ from ...params import ParamDesc, ParamDescs, TypeHint
 from ...types import Event, WithMountNsID
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
-from ..source_gadget import (PtraceAttachMixin, SourceTraceGadget,
-                             container_key, source_params)
+from ..source_gadget import (NsRefcountAttachMixin, PtraceAttachMixin,
+                             SourceTraceGadget, source_params)
 from ...sources import bridge as B
 
 
-class _MountAttachMixin:
+class _MountAttachMixin(NsRefcountAttachMixin):
     """Per-container fanotify attach: a mount mark on "/" covers only the
     HOST root mount — container overlay roots are separate mounts whose
-    opens it never sees. Each discovered container gets its own fanotify
-    source marking /proc/<pid>/root (the container's root mount, reachable
-    without entering the mount ns). Containers sharing our mount ns are
-    no-ops — the main mark already covers them (and procfs-discovered
-    host processes would re-mark the host root)."""
+    opens it never sees. Each distinct mount ns gets one fanotify source
+    marking /proc/<pid>/root (the container's root mount, reachable
+    without entering the mount ns); submounts/volumes remain the gap vs
+    kprobes."""
 
-    attach_requires_selector = False
-    attach_replaces_main = False
+    attach_ns = "mnt"
 
-    def attach_container(self, container) -> None:
-        pid = int(getattr(container, "pid", 0))
-        if pid <= 0:
-            raise ValueError(f"attach needs a live pid, got {pid}")
-        if os.stat(f"/proc/{pid}/ns/mnt").st_ino == \
-                os.stat("/proc/self/ns/mnt").st_ino:
-            return
-        self._attach_native_source(
-            container_key(container), B.SRC_FANOTIFY_OPEN,
-            cfg=B.make_cfg(paths=f"/proc/{pid}/root", modify=1))
-
-    def detach_container(self, container) -> None:
-        self._detach_key(container_key(container))
+    def _ns_source_args(self, pid: int):
+        return (B.SRC_FANOTIFY_OPEN,
+                B.make_cfg(paths=f"/proc/{pid}/root", modify=1), 0)
 
 # EventKind values (native/events.h)
 EV_OPEN, EV_BIND, EV_SIGNAL, EV_MOUNT, EV_OOMKILL = 3, 8, 9, 10, 11
@@ -192,7 +179,21 @@ class MountEvent(_Base):
     fstype: str = col("", width=8)
 
 
-class TraceMount(SourceTraceGadget):
+class _MntNsAttachMixin(NsRefcountAttachMixin):
+    """Per-container mountinfo attach: the host mountinfo can't see a
+    container's private mount namespace, so each distinct mount ns gets a
+    poller on a member container's /proc/<pid>/mountinfo. The poller is
+    bound to that pid's proc view: if the member pid exits while siblings
+    share the ns, the source ends quietly (no spurious umount flood) and
+    the ns goes unwatched until the next attach."""
+
+    attach_ns = "mnt"
+
+    def _ns_source_args(self, pid: int):
+        return B.SRC_MOUNTINFO, B.make_cfg(pid=pid), 0
+
+
+class TraceMount(_MntNsAttachMixin, SourceTraceGadget):
     native_kind = B.SRC_MOUNTINFO
     synth_kind = B.SRC_SYNTH_EXEC
     kind_filter = (EV_MOUNT,)

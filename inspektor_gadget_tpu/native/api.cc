@@ -141,7 +141,7 @@ uint64_t ig_source_create_cfg(uint32_t kind, const char* cfg,
       s = new FanotifyOpenSource(cap, c);
       break;
     case IG_SRC_MOUNTINFO:
-      s = new MountInfoSource(cap);
+      s = new MountInfoSource(cap, c);
       break;
     case IG_SRC_SOCK_DIAG:
       s = new SockDiagBindSource(cap, c);

@@ -38,6 +38,12 @@ int main() {
       started++;
       hs.push_back(h);
     }
+    if (started < (int)(sizeof(kinds) / sizeof(kinds[0]))) {
+      fprintf(stderr, "only %d/%zu sources created — races not fully "
+                      "exercised\n",
+              started, sizeof(kinds) / sizeof(kinds[0]));
+      return 1;
+    }
     std::atomic<bool> stop{false};
     // poller thread per source
     std::vector<std::thread> ts;

@@ -433,14 +433,16 @@ class ProcExecSource : public Source {
     fd = open(path, O_RDONLY);
     if (fd >= 0) {
       char ab[2048];
-      ssize_t n = read(fd, ab, sizeof(ab) - 1);
+      // read 3 bytes short of the buffer so the marker ALWAYS fits — a
+      // cap landing mid-argument is the common truncation case
+      ssize_t n = read(fd, ab, sizeof(ab) - 4);
       close(fd);
-      bool truncated = n == (ssize_t)sizeof(ab) - 1;
+      bool truncated = n == (ssize_t)sizeof(ab) - 4;
       while (n > 0 && ab[n - 1] == 0) n--;  // trailing NUL(s)
       if (n > 0) {
         for (ssize_t i = 0; i < n; i++)
           if (ab[i] == 0) ab[i] = ' ';
-        if (truncated && n <= (ssize_t)sizeof(ab) - 4) {
+        if (truncated) {
           memcpy(ab + n, "...", 3);
           n += 3;
         }

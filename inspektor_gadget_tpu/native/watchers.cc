@@ -160,7 +160,13 @@ class FanotifyOpenSource : public Source {
 
 class MountInfoSource : public Source {
  public:
-  explicit MountInfoSource(size_t ring_pow2) : Source(ring_pow2) {}
+  MountInfoSource(size_t ring_pow2, const std::string& cfg = "")
+      : Source(ring_pow2) {
+    // a container's private mount ns is invisible in the host mountinfo;
+    // the per-container attach passes its pid and we poll THAT process's
+    // view (/proc/<pid>/mountinfo is pollable exactly like self's)
+    pid_ = atoi(cfg_get(cfg, "pid", "0").c_str());
+  }
   ~MountInfoSource() override { stop(); }
 
  protected:
@@ -169,7 +175,12 @@ class MountInfoSource : public Source {
   };
 
   void run() override {
-    int fd = open("/proc/self/mountinfo", O_RDONLY);
+    char path[64];
+    if (pid_ > 0)
+      snprintf(path, sizeof(path), "/proc/%d/mountinfo", pid_);
+    else
+      snprintf(path, sizeof(path), "/proc/self/mountinfo");
+    int fd = open(path, O_RDONLY);
     if (fd < 0) return;
     std::map<uint64_t, MountEnt> known;
     scan(fd, known);  // baseline: no events for pre-existing mounts
@@ -179,6 +190,12 @@ class MountInfoSource : public Source {
       if (r <= 0) continue;
       std::map<uint64_t, MountEnt> cur;
       scan(fd, cur);
+      // An EMPTY scan means the window died, not that every mount went
+      // away: a per-container poller whose pid exited reads nothing (the
+      // mount ns may live on in sibling containers) — ending quietly
+      // beats emitting a spurious umount flood. A real mount ns always
+      // has at least the root mount.
+      if (cur.empty()) break;
       uint64_t ts = now_ns();
       for (auto& [id, m] : cur)
         if (!known.count(id)) push_mount(ts, m, /*umount=*/false);
@@ -227,6 +244,8 @@ class MountInfoSource : public Source {
       out[id] = MountEnt{target, source, fstype};
     }
   }
+
+  int pid_ = 0;
 };
 
 // One /proc pass resolving socket inodes to owning pids (shared by the

@@ -137,6 +137,49 @@ def test_profile_blockio_per_io_distribution():
     assert sum(counts) >= 100, result.decode()
 
 
+def test_trace_mount_per_container_mntns_attach():
+    """Mounts inside a container's private mount ns are invisible to the
+    host mountinfo; the Attacher path polls the container's own
+    /proc/<pid>/mountinfo (mountsnoop.bpf.c parity: system-wide
+    tracepoints see every mount ns)."""
+    import shutil
+    import subprocess
+    import threading
+
+    from inspektor_gadget_tpu.sources.bridge import native_available
+    if (not native_available() or os.geteuid() != 0
+            or not shutil.which("unshare")):
+        pytest.skip("netns tooling unavailable")
+
+    child = subprocess.Popen(
+        ["unshare", "-m", "bash", "-c",
+         "sleep 1.2; for i in 1 2 3; do mount -t tmpfs igtmp_$i /mnt; "
+         "sleep 0.4; umount /mnt; sleep 0.3; done; sleep 5"])
+    try:
+        time.sleep(0.3)
+        desc = get("trace", "mount")
+        ctx = GadgetContext(desc, gadget_params=desc.params().to_params(),
+                            timeout=5.0)
+        g = desc.new_instance(ctx)
+
+        class _C:
+            id = "mnt-probe"
+            pid = child.pid
+        g.attach_container(_C())
+        events = []
+        g.set_event_handler(events.append)
+        threading.Thread(target=ctx.wait_for_timeout_or_done,
+                         daemon=True).start()
+        g.run(ctx)
+    finally:
+        child.kill()
+        child.wait()
+    mine = [(e.operation, e.source) for e in events
+            if e is not None and "igtmp" in e.source]
+    assert any(op == "mount" for op, _ in mine), mine
+    assert any(op == "umount" for op, _ in mine), mine
+
+
 def test_trace_exec_args_and_ppid():
     """The native exec window carries execsnoop's headline columns: ARGS
     (full argv) and PPID, enriched at capture time (tracer.go:169-181
