@@ -87,6 +87,60 @@ def test_fanout_runtime_merges_nodes(agents):
     runtime.close()
 
 
+def test_fanout_real_host_wide_window(agents):
+    """The distributed plane carries REAL capture windows, not just the
+    synthetic streams: trace/capabilities through the gRPC fan-out with a
+    live unprivileged-chown workload must deliver denial rows from every
+    node (each agent runs its own host-wide window)."""
+    import os
+    import shutil
+    import subprocess
+
+    from inspektor_gadget_tpu.runtime import GrpcRuntime
+    from inspektor_gadget_tpu.sources.bridge import (audit_supported,
+                                                     captrace_supported)
+    if os.geteuid() != 0 or not shutil.which("setpriv"):
+        pytest.skip("needs root + setpriv")
+    if not (captrace_supported() or audit_supported()):
+        pytest.skip("no host-wide capability window")
+
+    target = f"/tmp/ig_fanout_cap_{os.getpid()}"
+    open(target, "w").close()
+    stop = threading.Event()
+
+    def trigger():
+        time.sleep(0.8)
+        while not stop.is_set():
+            subprocess.run(["setpriv", "--reuid", "65534", "--clear-groups",
+                            "chown", "0:0", target],
+                           check=False, stderr=subprocess.DEVNULL)
+            stop.wait(0.25)
+
+    t = threading.Thread(target=trigger)
+    t.start()
+    runtime = None
+    try:
+        desc = get("trace", "capabilities")
+        params = desc.params().to_params()
+        ctx = GadgetContext(desc, gadget_params=params, timeout=4.0)
+        runtime = GrpcRuntime(dict(agents))
+        events = []
+        result = runtime.run_gadget(ctx, on_event=events.append)
+    finally:
+        if runtime is not None:
+            runtime.close()
+        stop.set()
+        t.join()
+        os.unlink(target)
+    assert not result.errors(), result.errors()
+    denials = [e for e in events
+               if getattr(e, "cap", "") == "CHOWN"
+               and getattr(e, "verdict", "") == "deny"]
+    assert denials, f"{len(events)} events, no CHOWN denials"
+    # every node observed the host-wide workload (shared kernel)
+    assert {e.node for e in denials} == {"node-0", "node-1", "node-2"}
+
+
 def test_fanout_node_filter(agents):
     from inspektor_gadget_tpu.runtime import GrpcRuntime
 
