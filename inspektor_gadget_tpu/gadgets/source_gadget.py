@@ -45,6 +45,67 @@ def container_key(container) -> str:
             or str(getattr(container, "pid", 0)))
 
 
+# kernel pseudo-filesystems: no value watching their churn, and fanotify
+# marks there can fail
+_FANOTIFY_SKIP_FSTYPES = {
+    "proc", "sysfs", "devpts", "devtmpfs", "cgroup", "cgroup2",
+    "securityfs", "debugfs", "tracefs", "mqueue", "bpf", "fusectl",
+    "configfs", "pstore", "efivarfs",
+}
+
+
+def _unescape_mountinfo(path: str) -> str:
+    """mountinfo octal-escapes spaces/tabs/backslashes (\\040 etc.) in
+    path fields; decode them or mounts at such paths get nonexistent mark
+    paths and silently drop out of coverage."""
+    if "\\" not in path:
+        return path
+    out = []
+    i = 0
+    while i < len(path):
+        c = path[i]
+        if c == "\\" and i + 3 < len(path) + 1 and path[i + 1:i + 4].isdigit():
+            try:
+                out.append(chr(int(path[i + 1:i + 4], 8)))
+                i += 4
+                continue
+            except ValueError:
+                pass
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def fanotify_mount_paths(pid: int, max_marks: int = 32) -> list[str]:
+    """Markable mounts of a container: its root mount plus submounts
+    (volumes, emptyDirs) via /proc/<pid>/root/<target> — all reachable
+    without entering the mount ns. Mounts created after the snapshot are
+    the remaining gap vs the reference's kprobes. Returned as a LIST —
+    join with the \\x1e list separator (make_cfg does this), never ':',
+    which is legal inside mount points."""
+    root = f"/proc/{pid}/root"
+    paths = [root]
+    try:
+        with open(f"/proc/{pid}/mountinfo") as f:
+            for line in f:
+                dash = line.find(" - ")
+                if dash < 0:
+                    continue
+                fields = line.split()
+                target = _unescape_mountinfo(
+                    fields[4] if len(fields) > 4 else "")
+                fstype = line[dash + 3:].split()[0]
+                if (not target or target == "/"
+                        or fstype in _FANOTIFY_SKIP_FSTYPES):
+                    continue
+                paths.append(root + target)
+                if len(paths) >= max_marks:
+                    break
+    except OSError:
+        pass  # container gone mid-attach: root mark alone
+    return paths
+
+
 class NsRefcountAttachMixin:
     """Per-container attach with ONE source per distinct namespace (ref:
     networktracer/tracer.go:54-220's refcounted per-netns attachments).

@@ -150,9 +150,10 @@ class TopFile(IntervalGadget):
                 os.stat("/proc/self/ns/mnt").st_ino:
             return  # the main "/" mark already covers our own mount ns
         key = container_key(container)
+        from ..source_gadget import fanotify_mount_paths
         src = NativeCapture(SRC_FANOTIFY_OPEN, ring_pow2=18,
                             batch_size=8192,
-                            cfg=make_cfg(paths=f"/proc/{pid}/root",
+                            cfg=make_cfg(paths=fanotify_mount_paths(pid),
                                          modify=1))
         if self._mntns_filter is not None:
             src.set_filter(self._mntns_filter)

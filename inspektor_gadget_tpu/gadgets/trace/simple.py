@@ -35,7 +35,8 @@ from ...types import Event, WithMountNsID
 from ..interface import GadgetDesc, GadgetType
 from ..registry import register
 from ..source_gadget import (NsRefcountAttachMixin, PtraceAttachMixin,
-                             SourceTraceGadget, source_params)
+                             SourceTraceGadget, fanotify_mount_paths,
+                             source_params)
 from ...sources import bridge as B
 
 
@@ -43,15 +44,17 @@ class _MountAttachMixin(NsRefcountAttachMixin):
     """Per-container fanotify attach: a mount mark on "/" covers only the
     HOST root mount — container overlay roots are separate mounts whose
     opens it never sees. Each distinct mount ns gets one fanotify source
-    marking /proc/<pid>/root (the container's root mount, reachable
-    without entering the mount ns); submounts/volumes remain the gap vs
-    kprobes."""
+    marking the container's root mount AND its submounts (volumes,
+    emptyDirs) via /proc/<pid>/root/<target>, all reachable without
+    entering the mount ns. Pseudo-filesystems are skipped; mounts created
+    AFTER attach are the remaining (small) gap vs kprobes."""
 
     attach_ns = "mnt"
 
     def _ns_source_args(self, pid: int):
         return (B.SRC_FANOTIFY_OPEN,
-                B.make_cfg(paths=f"/proc/{pid}/root", modify=1), 0)
+                B.make_cfg(paths=fanotify_mount_paths(pid),
+                           modify=1), 0)
 
 # EventKind values (native/events.h)
 EV_OPEN, EV_BIND, EV_SIGNAL, EV_MOUNT, EV_OOMKILL = 3, 8, 9, 10, 11

@@ -86,7 +86,12 @@ class FanotifyOpenSource : public Source {
  public:
   FanotifyOpenSource(size_t ring_pow2, const std::string& cfg)
       : Source(ring_pow2) {
-    paths_ = split_str(cfg_get(cfg, "paths", "/"), ':');
+    // list values arrive \x1e-separated (make_cfg's list contract) since
+    // ':' is legal inside mount points; the user-facing CLI colon syntax
+    // stays supported when no \x1e is present
+    std::string raw = cfg_get(cfg, "paths", "/");
+    paths_ = split_str(raw, raw.find('\x1e') != std::string::npos ? '\x1e'
+                                                                  : ':');
     if (paths_.empty()) paths_ = {"/"};
     include_modify_ = cfg_get(cfg, "modify", "1") != "0";
   }

@@ -548,12 +548,14 @@ def test_trace_open_per_container_mount_attach():
             or not shutil.which("unshare")):
         pytest.skip("fanotify/netns tooling unavailable")
 
-    # writes land on the container's ROOT mount (a private clone of the
-    # host root vfsmount — the host "/" mark does not see accesses through
-    # it); container submounts/volumes are a documented limitation
+    # writes land on BOTH the container's root mount (a private clone the
+    # host "/" mark does not see) and a volume-style tmpfs submount, which
+    # the attach covers via the container's mount table
     child = subprocess.Popen(
         ["unshare", "-m", "bash", "-c",
-         "for i in $(seq 1 60); do echo hi > /ig_attach_open_$i; "
+         "mount -t tmpfs igvol /mnt; sleep 0.8; "
+         "for i in $(seq 1 50); do echo hi > /ig_attach_open_$i; "
+         "echo hi > /mnt/ig_attach_vol_$i; "
          "sleep 0.1; done; rm -f /ig_attach_open_*"])
     try:
         time.sleep(0.8)
@@ -584,6 +586,11 @@ def test_trace_open_per_container_mount_attach():
             if e is not None and "ig_attach_open_" in e.path]
     assert mine, sorted({e.path for e in events if e is not None})[:10]
     assert any(e.op == "write" and e.pid > 0 for e in mine)
+    # volume-style submounts are covered too (marked from the container's
+    # own mount table)
+    vol = [e for e in events
+           if e is not None and "ig_attach_vol_" in e.path]
+    assert vol, sorted({e.path for e in events if e is not None})[:10]
 
 
 def test_snapshot_socket_covers_container_netns():
