@@ -182,3 +182,30 @@ class AgentClient:
         )
         h, _ = wire.decode_msg(method(wire.encode_msg({}), timeout=CONNECT_TIMEOUT))
         return h
+
+    # -- Trace resources (ref: utils/trace.go:340-848 CreateTrace/
+    #    SetTraceOperation/getTraceListFromOptions, over agent RPCs) --------
+
+    def _unary(self, name: str, msg: dict) -> dict:
+        method = self.channel.unary_unary(
+            f"/igtpu.GadgetManager/{name}",
+            request_serializer=wire.identity_serializer,
+            response_deserializer=wire.identity_deserializer,
+        )
+        h, _ = wire.decode_msg(method(wire.encode_msg(msg),
+                                      timeout=CONNECT_TIMEOUT))
+        if h.get("error"):
+            raise RuntimeError(h["error"])
+        return h
+
+    def apply_trace(self, doc: dict) -> dict:
+        return self._unary("ApplyTrace", {"trace": doc})["trace"]
+
+    def get_trace(self, name: str) -> dict:
+        return self._unary("GetTrace", {"name": name})["trace"]
+
+    def list_traces(self) -> list[dict]:
+        return self._unary("ListTraces", {})["traces"]
+
+    def delete_trace(self, name: str) -> bool:
+        return self._unary("DeleteTrace", {"name": name})["deleted"]

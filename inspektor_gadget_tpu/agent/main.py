@@ -32,6 +32,11 @@ def main(argv=None) -> int:
     sp.add_argument("--kube-api", default="",
                     help="apiserver URL for pod-informer discovery")
     sp.add_argument("--informer-interval", type=float, default=2.0)
+    sp.add_argument("--watch-traces", action="store_true",
+                    help="reconcile Trace resources off the kube API "
+                         "(requires --kube-api; controller role of "
+                         "gadget-container main.go:262-299)")
+    sp.add_argument("--trace-namespace", default="ig-tpu")
     sp.add_argument("--no-doctor", action="store_true",
                     help="skip the capture-window probe at startup")
     sp.add_argument("--install-hooks", action="store_true",
@@ -105,6 +110,8 @@ def main(argv=None) -> int:
                             nri=args.nri)
 
     if args.cmd == "serve":
+        if args.watch_traces and not args.kube_api:
+            ap.error("--watch-traces requires --kube-api")
         # entrypoint-analogue environment probe (ref: entrypoint.sh:21-120
         # detects OS/kernel/runtime before starting the daemon): report
         # which capture windows work on this host so degraded gadgets are
@@ -146,7 +153,16 @@ def _serve_loop(args) -> int:
     # nobody serves stalls every container creation on the host
     server, _agent = serve(args.listen, node_name=args.node_name)
     installer = None
+    watcher = None
     try:
+        if args.watch_traces and args.kube_api:
+            from ..gadgets.trace_resource import TraceWatcher
+            from ..utils.k8s import KubeClient
+            watcher = TraceWatcher(
+                KubeClient(server=args.kube_api), _agent.traces,
+                namespace=args.trace_namespace,
+                interval=args.informer_interval)
+            watcher.start()
         if args.install_hooks:
             from .hooks import HookInstaller
             installer = HookInstaller(args.host_root, args.listen)
@@ -189,6 +205,8 @@ def _serve_loop(args) -> int:
         # the grace window must not invoke hooks against a dead socket —
         # and stop unconditionally, else a failed informer/install leaves
         # non-daemon gRPC workers keeping a dead agent alive
+        if watcher is not None:
+            watcher.stop()
         if installer is not None:
             installer.uninstall()
         server.stop(grace=2.0)

@@ -45,6 +45,10 @@ class AgentServer:
         self.runtime = LocalRuntime(node_name=node_name)
         self._runs: dict[str, GadgetContext] = {}
         self._runs_mu = threading.Lock()
+        # legacy CRD-path serving (ref: main.go:262-299 starts the Trace
+        # controller inside the node daemon)
+        from ..gadgets.trace_resource import TraceStore
+        self.traces = TraceStore(node_name=node_name)
 
     # -- GadgetManager.GetCatalog ------------------------------------------
 
@@ -221,6 +225,29 @@ class AgentServer:
             lm.cc.remove_container(h.get("container", {}).get("id", ""))
         return wire.encode_msg({"ok": True})
 
+    # -- Trace-resource RPCs (ref: §3.5 — the CRD path served remotely) -----
+
+    def apply_trace(self, request: bytes, context) -> bytes:
+        h, _ = wire.decode_msg(request)
+        try:
+            return wire.encode_msg({"trace": self.traces.apply(h.get("trace", {}))})
+        except Exception as e:
+            return wire.encode_msg({"error": str(e)})
+
+    def get_trace(self, request: bytes, context) -> bytes:
+        h, _ = wire.decode_msg(request)
+        doc = self.traces.get(h.get("name", ""))
+        if doc is None:
+            return wire.encode_msg({"error": f"trace {h.get('name')!r} not found"})
+        return wire.encode_msg({"trace": doc})
+
+    def list_traces(self, request: bytes, context) -> bytes:
+        return wire.encode_msg({"traces": self.traces.list()})
+
+    def delete_trace(self, request: bytes, context) -> bytes:
+        h, _ = wire.decode_msg(request)
+        return wire.encode_msg({"deleted": self.traces.delete(h.get("name", ""))})
+
     # -- dump-state debug RPC (ref: gadgettracermanager.go DumpState :204) --
 
     def dump_state(self, request: bytes, context) -> bytes:
@@ -278,6 +305,10 @@ def serve(address: str = "unix:///tmp/igtpu-agent.sock",
         "AddContainer": _method(agent.add_container, "unary"),
         "RemoveContainer": _method(agent.remove_container, "unary"),
         "DumpState": _method(agent.dump_state, "unary"),
+        "ApplyTrace": _method(agent.apply_trace, "unary"),
+        "GetTrace": _method(agent.get_trace, "unary"),
+        "ListTraces": _method(agent.list_traces, "unary"),
+        "DeleteTrace": _method(agent.delete_trace, "unary"),
     }
     server.add_generic_rpc_handlers((
         grpc.method_handlers_generic_handler("igtpu.GadgetManager", handlers),

@@ -89,6 +89,25 @@ def build_parser() -> argparse.ArgumentParser:
     vp = sub.add_parser("version", help="print version")
     vp.set_defaults(func=lambda a: (print(_version()), 0)[1])
 
+    # legacy CRD-path verbs (ref: cmd/kubectl-gadget/utils/trace.go:340-848 —
+    # CreateTrace / SetTraceOperation / waitForCondition, over agent RPCs)
+    tp = sub.add_parser("traces", help="Trace-resource lifecycle on agents")
+    tsub = tp.add_subparsers(dest="verb", required=True)
+    for verb in ("start", "stop", "generate", "get", "delete", "list"):
+        vparser = tsub.add_parser(verb)
+        vparser.add_argument("--remote", default="",
+                             help="name=target[,...]; defaults to the local fleet")
+        if verb != "list":
+            vparser.add_argument("--name", required=True)
+        if verb == "start":
+            vparser.add_argument("--gadget", required=True,
+                                 help="category/name, e.g. advise/seccomp-profile")
+            vparser.add_argument("--node", default="",
+                                 help="restrict the trace to one node")
+            vparser.add_argument("-p", "--param", action="append", default=[],
+                                 help="gadget parameter k=v (repeatable)")
+        vparser.set_defaults(func=cmd_traces, verb=verb)
+
     from ..gadgets.registry import categories
     for category, descs in categories().items():
         catp = sub.add_parser(category, help=f"{category} gadgets")
@@ -260,6 +279,71 @@ def cmd_debug(args) -> int:
             print(json.dumps(state, indent=2, default=str))
         except Exception as e:  # noqa: BLE001 — per-node isolation
             print(f"=== {node} ({target}) === error: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_traces(args) -> int:
+    """Serve the §3.5 call stack from the client side: build a CR-shaped
+    Trace doc, apply it with the operation annotation to every agent (one
+    Trace per node, as utils/trace.go:340 creates), surface status/output."""
+    from ..agent.client import AgentClient
+    from ..gadgets.trace_resource import OPERATION_ANNOTATION
+    from .deploy import local_targets
+    try:
+        targets = parse_targets(args.remote) if args.remote else local_targets()
+    except ParamError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not targets:
+        print("no agents (use deploy --local N or --remote)", file=sys.stderr)
+        return 2
+    params = {}
+    if args.verb == "start":
+        for kv in args.param:
+            if "=" not in kv:
+                print(f"error: bad -p {kv!r}: expected k=v", file=sys.stderr)
+                return 2
+            k, v = kv.split("=", 1)
+            params[k] = v
+    rc = 0
+    for node, target in targets.items():
+        try:
+            client = AgentClient(target, node_name=node)
+            if args.verb == "list":
+                for doc in client.list_traces():
+                    st = doc.get("status", {})
+                    print(f"{node:12s} {doc['metadata']['name']:20s} "
+                          f"{doc['spec'].get('gadget', ''):24s} "
+                          f"{st.get('state', '')}"
+                          + (f"  error: {st['operationError']}"
+                             if st.get("operationError") else ""))
+                continue
+            if args.verb == "delete":
+                print(f"{node}: deleted={client.delete_trace(args.name)}")
+                continue
+            if args.verb == "get":
+                doc = client.get_trace(args.name)
+            else:  # start/stop/generate ride the operation annotation
+                doc = {
+                    "metadata": {"name": args.name,
+                                 "annotations": {OPERATION_ANNOTATION: args.verb}},
+                    "spec": ({"gadget": args.gadget, "node": args.node,
+                              "parameters": params}
+                             if args.verb == "start" else {}),
+                }
+                doc = client.apply_trace(doc)
+            st = doc.get("status", {})
+            if st.get("operationError"):
+                print(f"{node}: error: {st['operationError']}", file=sys.stderr)
+                rc = 1
+            elif args.verb in ("generate", "get") and st.get("output"):
+                print(f"=== {node} ===")
+                print(st["output"], end="" if st["output"].endswith("\n") else "\n")
+            else:
+                print(f"{node}: {doc['metadata']['name']} {st.get('state', '')}")
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            print(f"{node}: error: {e}", file=sys.stderr)
             rc = 1
     return rc
 

@@ -13,8 +13,12 @@ in the reference.
 
 Here the same shapes run against the modern gadget registry: a factory's
 start/stop/generate operations drive a background gadget run and park the
-result in trace.status.output — no kube API required, and an agent can host
-the reconciler to serve remote Trace lifecycles.
+result in trace.status.output — no kube API required. The serving surface:
+`TraceStore` hosts the reconciler behind the agent's Apply/Get/List/Delete
+Trace RPCs (the daemon role of gadget-container/gadgettracermanager/
+main.go:262-299), and `TraceWatcher` drives the same store from CR-shaped
+documents polled off a kube apiserver, writing status back — the
+trace_controller.go reconcile loop without client-go.
 """
 
 from __future__ import annotations
@@ -137,7 +141,7 @@ class TraceReconciler:
 
     def _op_stop(self, trace: TraceResource) -> None:
         with self._mu:
-            run = self._runs.get(trace.name)
+            run = self._runs.pop(trace.name, None)
         if run is None:
             raise ValueError(f"trace {trace.name!r} not running")
         run.ctx.cancel()
@@ -164,3 +168,209 @@ class TraceReconciler:
     def active(self) -> list[str]:
         with self._mu:
             return list(self._runs)
+
+
+# -- CR-shaped document serialization ---------------------------------------
+# The wire/API shape mirrors the reference CRD (pkg/apis/gadget/v1alpha1/
+# types.go:24-140): apiVersion/kind/metadata{name,annotations}/spec/status.
+
+API_VERSION = "gadget.ig-tpu.io/v1alpha1"
+KIND = "Trace"
+
+
+def trace_to_doc(trace: TraceResource) -> dict:
+    return {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": trace.name,
+                     "annotations": dict(trace.annotations)},
+        "spec": {
+            "node": trace.spec.node,
+            "gadget": trace.spec.gadget,
+            "filter": dict(trace.spec.filter),
+            "runMode": trace.spec.run_mode,
+            "outputMode": trace.spec.output_mode,
+            "parameters": dict(trace.spec.parameters),
+        },
+        "status": {
+            "state": trace.status.state,
+            "operationError": trace.status.operation_error,
+            "output": trace.status.output,
+        },
+    }
+
+
+def trace_from_doc(doc: dict) -> TraceResource:
+    meta = doc.get("metadata", {})
+    spec = doc.get("spec", {})
+    status = doc.get("status", {}) or {}
+    return TraceResource(
+        name=meta.get("name", ""),
+        spec=TraceSpec(
+            node=spec.get("node", ""),
+            gadget=spec.get("gadget", ""),
+            filter=dict(spec.get("filter", {})),
+            run_mode=spec.get("runMode", "manual"),
+            output_mode=spec.get("outputMode", "Status"),
+            parameters=dict(spec.get("parameters", {})),
+        ),
+        status=TraceStatus(
+            state=status.get("state", ""),
+            operation_error=status.get("operationError", ""),
+            output=status.get("output", ""),
+        ),
+        annotations=dict(meta.get("annotations", {})),
+    )
+
+
+class TraceStore:
+    """Agent-side Trace registry: documents in, reconciled documents out.
+
+    The daemon-hosted half of the L9 path (ref: main.go:262-299 starts the
+    CRD controller in the node daemon): `apply` is one reconcile —
+    annotation-driven operation dispatch against the live registry — and
+    the store keeps the resulting resource so later operations (stop,
+    generate) find the running trace.
+    """
+
+    def __init__(self, node_name: str = "local"):
+        self.reconciler = TraceReconciler(node_name=node_name)
+        self._traces: dict[str, TraceResource] = {}
+        self._mu = threading.Lock()
+
+    def apply(self, doc: dict) -> dict:
+        incoming = trace_from_doc(doc)
+        if not incoming.name:
+            raise ValueError("trace document has no metadata.name")
+        # node filter before any store: a trace pinned elsewhere must not
+        # become an inert local resource with a forever-pending annotation
+        if (incoming.spec.node
+                and incoming.spec.node != self.reconciler.node_name):
+            return trace_to_doc(incoming)
+        with self._mu:
+            existing = self._traces.get(incoming.name)
+        if existing is not None:
+            if incoming.spec.gadget and incoming.spec != existing.spec:
+                # a spec update is only safe while nothing runs against the
+                # old one; reject loudly rather than silently keeping it
+                if existing.name in self.reconciler.active():
+                    existing.status.operation_error = (
+                        "spec update rejected: trace is running (stop first)")
+                    existing.annotations.update(incoming.annotations)
+                    return trace_to_doc(existing)
+                existing.spec = incoming.spec
+            # operations arrive as annotations on the stored resource
+            # (trace_controller.go:100)
+            existing.annotations.update(incoming.annotations)
+            trace = existing
+        else:
+            trace = incoming
+        self.reconciler.reconcile(trace)
+        with self._mu:
+            # an operation aimed at a name that was never created is an
+            # error reply, not a new phantom resource
+            if existing is not None or trace.spec.gadget:
+                self._traces[trace.name] = trace
+        return trace_to_doc(trace)
+
+    def get(self, name: str) -> dict | None:
+        with self._mu:
+            trace = self._traces.get(name)
+        return trace_to_doc(trace) if trace is not None else None
+
+    def list(self) -> list[dict]:
+        with self._mu:
+            return [trace_to_doc(t) for t in self._traces.values()]
+
+    def delete(self, name: str) -> bool:
+        """Finalizer semantics (ref: trace_controller.go finalizers): a
+        still-running trace is stopped before the resource goes away."""
+        with self._mu:
+            trace = self._traces.pop(name, None)
+        if trace is None:
+            return False
+        if trace.name in self.reconciler.active():
+            trace.annotations[OPERATION_ANNOTATION] = "stop"
+            self.reconciler.reconcile(trace)
+        return True
+
+
+class TraceWatcher:
+    """Kube-API-fed reconcile loop (ref: trace_controller.go:100 under
+    controller-runtime; here a poll-diff loop over the CR REST path).
+
+    Polls `<base>/traces` off a KubeClient-shaped object (`get(path)` +
+    `send(path, body, method)`), feeds every document carrying the
+    operation annotation into a TraceStore, and writes the reconciled
+    status (and cleared annotation) back with a PUT — the status-update
+    half of the reconcile contract the CLI's waitForCondition watches
+    (cmd/kubectl-gadget/utils/trace.go:513).
+    """
+
+    BASE = "/apis/gadget.ig-tpu.io/v1alpha1"
+
+    def __init__(self, client: Any, store: TraceStore,
+                 namespace: str = "ig-tpu", interval: float = 1.0):
+        self.client = client
+        self.store = store
+        self.namespace = namespace
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _path(self, name: str = "") -> str:
+        p = f"{self.BASE}/namespaces/{self.namespace}/traces"
+        return f"{p}/{name}" if name else p
+
+    def poll_once(self) -> int:
+        """One list+reconcile+writeback cycle; returns #operations served.
+        Apiserver blips leave local state untouched (informer resync
+        stance, same as the pod informer)."""
+        try:
+            items = self.client.get(self._path()).get("items", [])
+        except Exception:
+            return 0
+        served = 0
+        for doc in items:
+            annotations = doc.get("metadata", {}).get("annotations", {})
+            if OPERATION_ANNOTATION not in annotations:
+                continue
+            node = doc.get("spec", {}).get("node", "")
+            if node and node != self.store.reconciler.node_name:
+                continue  # node filter (ref: :172-175)
+            name = doc.get("metadata", {}).get("name", "")
+            try:
+                updated = self.store.apply(doc)
+            except Exception as e:
+                updated = dict(doc)
+                updated.setdefault("status", {})["operationError"] = str(e)
+                updated["metadata"] = {
+                    **doc.get("metadata", {}),
+                    "annotations": {k: v for k, v in annotations.items()
+                                    if k != OPERATION_ANNOTATION}}
+            try:
+                self.client.send(self._path(name), updated, method="PUT")
+                served += 1
+            except Exception:
+                pass
+        return served
+
+    def start(self) -> None:
+        if self._thread:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                self.poll_once()
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="trace-watcher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+            self._thread = None

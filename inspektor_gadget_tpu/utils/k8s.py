@@ -53,19 +53,39 @@ class KubeClient:
 
     # -- transport ----------------------------------------------------------
 
+    def _ssl_ctx(self):
+        if not self.server.startswith("https"):
+            return None
+        if self.insecure:
+            return ssl._create_unverified_context()  # noqa: S323
+        if self.ca_cert:
+            return ssl.create_default_context(cafile=self.ca_cert)
+        return None
+
     def get(self, path: str) -> dict:
         req = urllib.request.Request(self.server + path)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
-        ctx = None
-        if self.server.startswith("https"):
-            if self.insecure:
-                ctx = ssl._create_unverified_context()  # noqa: S323
-            elif self.ca_cert:
-                ctx = ssl.create_default_context(cafile=self.ca_cert)
         with urllib.request.urlopen(req, timeout=self.timeout,
-                                    context=ctx) as resp:
+                                    context=self._ssl_ctx()) as resp:
             return json.load(resp)
+
+    def send(self, path: str, body: dict, method: str = "PUT") -> dict:
+        """Write a resource (PUT/PATCH/POST); returns the response body.
+        The write half the Trace controller needs to park status/output on
+        the resource (trace_controller.go's Status().Update role)."""
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(self.server + path, data=data,
+                                     method=method)
+        req.add_header("Content-Type",
+                       "application/merge-patch+json" if method == "PATCH"
+                       else "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        with urllib.request.urlopen(req, timeout=self.timeout,
+                                    context=self._ssl_ctx()) as resp:
+            raw = resp.read()
+        return json.loads(raw) if raw else {}
 
     # -- typed helpers ------------------------------------------------------
 
@@ -80,6 +100,11 @@ class KubeClient:
             params.append(f"labelSelector={label_selector}")
         if params:
             path += "?" + "&".join(params)
+        return self.get(path).get("items", [])
+
+    def list_services(self, namespace: str = "") -> list[dict]:
+        path = (f"/api/v1/namespaces/{namespace}/services" if namespace
+                else "/api/v1/services")
         return self.get(path).get("items", [])
 
     def list_nodes(self) -> list[dict]:

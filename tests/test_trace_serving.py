@@ -1,0 +1,275 @@
+"""Trace-resource serving: the §3.5 call stack against a live agent, plus
+the kube-API-backed controller loop against a fake apiserver.
+
+Reference tiers modeled: cmd/kubectl-gadget/utils/trace.go:340-848 (client
+creates a Trace, sets operation annotations, waits on status) and
+pkg/controllers/suite_test.go (reconciler against a real apiserver — here
+an in-process HTTP one serving/storing CR-shaped documents).
+"""
+
+import json
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.agent.client import AgentClient
+from inspektor_gadget_tpu.agent.service import serve
+from inspektor_gadget_tpu.gadgets.trace_resource import (
+    OPERATION_ANNOTATION,
+    STATE_COMPLETED,
+    STATE_STARTED,
+    TraceStore,
+    TraceWatcher,
+    trace_from_doc,
+    trace_to_doc,
+)
+from inspektor_gadget_tpu.utils.k8s import KubeClient
+
+
+@pytest.fixture(scope="module")
+def agent():
+    tmp = tempfile.mkdtemp()
+    addr = f"unix://{tmp}/agent.sock"
+    server, agent_obj = serve(addr, node_name="node-t")
+    yield addr
+    server.stop(grace=0.5)
+
+
+def _start_doc(name, gadget, params=None, node=""):
+    return {
+        "metadata": {"name": name,
+                     "annotations": {OPERATION_ANNOTATION: "start"}},
+        "spec": {"gadget": gadget, "node": node,
+                 "parameters": params or {"source": "pysynthetic",
+                                          "rate": "20000"}},
+    }
+
+
+def _op_doc(name, op):
+    return {"metadata": {"name": name,
+                         "annotations": {OPERATION_ANNOTATION: op}}}
+
+
+def test_doc_roundtrip():
+    doc = _start_doc("t", "trace/exec")
+    trace = trace_from_doc(doc)
+    assert trace.spec.gadget == "trace/exec"
+    back = trace_to_doc(trace)
+    assert back["spec"]["parameters"]["source"] == "pysynthetic"
+    assert back["metadata"]["annotations"][OPERATION_ANNOTATION] == "start"
+
+
+def test_agent_serves_advise_lifecycle(agent):
+    """§3.5 end to end over RPC: start records, generate parks the OCI
+    seccomp JSON in status.output (seccomp factory contract)."""
+    client = AgentClient(agent, "node-t")
+    doc = client.apply_trace(_start_doc("adv1", "advise/seccomp-profile"))
+    assert doc["status"]["state"] == STATE_STARTED
+    assert doc["metadata"]["annotations"] == {}  # operation consumed
+    assert any(t["metadata"]["name"] == "adv1" for t in client.list_traces())
+    time.sleep(0.6)
+    doc = client.apply_trace(_op_doc("adv1", "generate"))
+    assert doc["status"]["state"] == STATE_COMPLETED, doc["status"]
+    profiles = json.loads(doc["status"]["output"])
+    assert profiles and "defaultAction" in next(iter(profiles.values()))
+    # the completed trace is fetchable until deleted
+    assert client.get_trace("adv1")["status"]["state"] == STATE_COMPLETED
+    assert client.delete_trace("adv1") is True
+    with pytest.raises(RuntimeError, match="not found"):
+        client.get_trace("adv1")
+    client.close()
+
+
+def test_agent_serves_traceloop(agent):
+    """traceloop rides the same path (ref: main.go:72 legacy commands)."""
+    client = AgentClient(agent, "node-t")
+    doc = client.apply_trace(_start_doc("tl1", "traceloop/traceloop"))
+    assert doc["status"]["state"] == STATE_STARTED
+    time.sleep(0.6)
+    doc = client.apply_trace(_op_doc("tl1", "generate"))
+    assert doc["status"]["state"] == STATE_COMPLETED, doc["status"]
+    assert "SYSCALL" in doc["status"]["output"]  # rendered syscall table
+    client.delete_trace("tl1")
+    client.close()
+
+
+def test_agent_reports_operation_error(agent):
+    client = AgentClient(agent, "node-t")
+    doc = client.apply_trace(_op_doc("ghost", "stop"))
+    assert "not running" in doc["status"]["operationError"]
+    # an operation on a never-created name must not mint a phantom resource
+    assert all(t["metadata"]["name"] != "ghost" for t in client.list_traces())
+    client.close()
+
+
+def test_stop_then_restart_and_spec_retry(agent):
+    """A stopped name is restartable, and a failed start can be retried
+    with a corrected spec (spec update allowed while not running)."""
+    client = AgentClient(agent, "node-t")
+    bad = _start_doc("retry1", "advise/no-such-gadget")
+    doc = client.apply_trace(bad)
+    assert doc["status"]["operationError"]
+    doc = client.apply_trace(_start_doc("retry1", "trace/exec"))
+    assert doc["status"]["state"] == STATE_STARTED, doc["status"]
+    # spec update against a RUNNING trace is rejected loudly
+    doc = client.apply_trace(_start_doc("retry1", "trace/tcp"))
+    assert "spec update rejected" in doc["status"]["operationError"]
+    doc = client.apply_trace(_op_doc("retry1", "stop"))
+    assert doc["status"]["state"] == "Stopped"
+    doc = client.apply_trace(_op_doc("retry1", "start"))
+    assert doc["status"]["state"] == STATE_STARTED
+    client.delete_trace("retry1")
+    client.close()
+
+
+def test_node_filter_no_phantom(agent):
+    """A trace pinned to another node is neither run nor stored."""
+    client = AgentClient(agent, "node-t")
+    doc = client.apply_trace(_start_doc("elsewhere", "trace/exec",
+                                        node="node-other"))
+    assert doc["status"]["state"] == ""
+    assert doc["metadata"]["annotations"].get(OPERATION_ANNOTATION) == "start"
+    assert all(t["metadata"]["name"] != "elsewhere"
+               for t in client.list_traces())
+    client.close()
+
+
+def test_delete_stops_running_trace(agent):
+    client = AgentClient(agent, "node-t")
+    client.apply_trace(_start_doc("run1", "trace/exec"))
+    assert client.delete_trace("run1") is True
+    assert all(t["metadata"]["name"] != "run1" for t in client.list_traces())
+    client.close()
+
+
+def test_cli_traces_verbs(agent, capsys):
+    """The kubectl-gadget advise ergonomics through `ig-tpu traces`."""
+    from inspektor_gadget_tpu.cli.main import main as cli_main
+
+    remote = f"node-t={agent}"
+    assert cli_main(["traces", "start", "--remote", remote, "--name", "c1",
+                     "--gadget", "advise/seccomp-profile",
+                     "-p", "source=pysynthetic", "-p", "rate=20000"]) == 0
+    out = capsys.readouterr().out
+    assert "c1 Started" in out
+    time.sleep(0.6)
+    assert cli_main(["traces", "list", "--remote", remote]) == 0
+    assert "advise/seccomp-profile" in capsys.readouterr().out
+    assert cli_main(["traces", "generate", "--remote", remote,
+                     "--name", "c1"]) == 0
+    out = capsys.readouterr().out
+    assert "defaultAction" in out
+    assert cli_main(["traces", "delete", "--remote", remote,
+                     "--name", "c1"]) == 0
+
+
+# -- kube-API-backed controller loop (fake apiserver tier) ------------------
+
+class _FakeTraceApi(BaseHTTPRequestHandler):
+    """CR-shaped document store: GET list, PUT single resource."""
+
+    store: dict = {}
+    puts: list = []
+
+    def _send(self, body: dict):
+        data = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path.endswith("/traces"):
+            self._send({"items": list(_FakeTraceApi.store.values())})
+        else:
+            name = self.path.rpartition("/")[2]
+            if name in _FakeTraceApi.store:
+                self._send(_FakeTraceApi.store[name])
+            else:
+                self.send_error(404)
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        doc = json.loads(self.rfile.read(n))
+        name = self.path.rpartition("/")[2]
+        _FakeTraceApi.store[name] = doc
+        _FakeTraceApi.puts.append((name, doc))
+        self._send(doc)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def fake_trace_api():
+    server = HTTPServer(("127.0.0.1", 0), _FakeTraceApi)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    _FakeTraceApi.store = {}
+    _FakeTraceApi.puts = []
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_watcher_reconciles_from_apiserver(fake_trace_api):
+    """trace_controller.go:100 against a (fake) apiserver: annotation in,
+    status written back, node filter honored."""
+    store = TraceStore(node_name="node-w")
+    watcher = TraceWatcher(KubeClient(server=fake_trace_api), store,
+                           namespace="ig-tpu")
+
+    _FakeTraceApi.store["k1"] = _start_doc("k1", "advise/seccomp-profile")
+    # a trace pinned to another node must be left alone (ref: :172-175)
+    _FakeTraceApi.store["other"] = _start_doc(
+        "other", "trace/exec", node="node-elsewhere")
+
+    assert watcher.poll_once() == 1
+    written = _FakeTraceApi.store["k1"]
+    assert written["status"]["state"] == STATE_STARTED
+    assert OPERATION_ANNOTATION not in written["metadata"]["annotations"]
+    assert _FakeTraceApi.store["other"].get("status") is None
+
+    # idempotent: no annotation left → nothing served
+    assert watcher.poll_once() == 0
+
+    time.sleep(0.6)
+    _FakeTraceApi.store["k1"]["metadata"]["annotations"][
+        OPERATION_ANNOTATION] = "generate"
+    assert watcher.poll_once() == 1
+    written = _FakeTraceApi.store["k1"]
+    assert written["status"]["state"] == STATE_COMPLETED, written["status"]
+    profiles = json.loads(written["status"]["output"])
+    assert "defaultAction" in next(iter(profiles.values()))
+
+
+def test_watcher_reports_bad_operation(fake_trace_api):
+    store = TraceStore(node_name="node-w")
+    watcher = TraceWatcher(KubeClient(server=fake_trace_api), store)
+    doc = _start_doc("bad", "no-such/gadget")
+    _FakeTraceApi.store["bad"] = doc
+    assert watcher.poll_once() == 1
+    written = _FakeTraceApi.store["bad"]
+    assert written["status"]["operationError"]
+
+
+def test_watcher_background_loop(fake_trace_api):
+    store = TraceStore(node_name="node-w")
+    watcher = TraceWatcher(KubeClient(server=fake_trace_api), store,
+                           interval=0.05)
+    watcher.start()
+    try:
+        _FakeTraceApi.store["bg"] = _start_doc("bg", "trace/exec")
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if _FakeTraceApi.store["bg"].get("status", {}).get("state"):
+                break
+            time.sleep(0.05)
+        assert _FakeTraceApi.store["bg"]["status"]["state"] == STATE_STARTED
+    finally:
+        watcher.stop()
+    store.delete("bg")
