@@ -32,6 +32,10 @@ def main(argv=None) -> int:
     sp.add_argument("--kube-api", default="",
                     help="apiserver URL for pod-informer discovery")
     sp.add_argument("--informer-interval", type=float, default=2.0)
+    sp.add_argument("--checkpoint-dir", default="",
+                    help="persist live sketch state here each interval; "
+                         "resumed (merged) after restart")
+    sp.add_argument("--checkpoint-interval", type=float, default=30.0)
     sp.add_argument("--watch-traces", action="store_true",
                     help="reconcile Trace resources off the kube API "
                          "(requires --kube-api; controller role of "
@@ -151,7 +155,9 @@ def _serve_loop(args) -> int:
     from .service import serve
     # bind BEFORE installing hooks: a prestart config pointing at a socket
     # nobody serves stalls every container creation on the host
-    server, _agent = serve(args.listen, node_name=args.node_name)
+    server, _agent = serve(args.listen, node_name=args.node_name,
+                           checkpoint_dir=args.checkpoint_dir,
+                           checkpoint_interval=args.checkpoint_interval)
     installer = None
     watcher = None
     try:
@@ -207,6 +213,7 @@ def _serve_loop(args) -> int:
         # non-daemon gRPC workers keeping a dead agent alive
         if watcher is not None:
             watcher.stop()
+        _agent.stop_checkpointer()
         if installer is not None:
             installer.uninstall()
         server.stop(grace=2.0)

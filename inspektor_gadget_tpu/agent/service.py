@@ -49,6 +49,30 @@ class AgentServer:
         # controller inside the node daemon)
         from ..gadgets.trace_resource import TraceStore
         self.traces = TraceStore(node_name=node_name)
+        self._ckpt_stop: threading.Event | None = None
+
+    def start_checkpointer(self, directory: str,
+                           interval: float = 30.0) -> None:
+        """Periodic sketch-state checkpointing (role of pinned BPF maps
+        surviving daemon restarts, pkg/gadgets/helpers.go:36): every live
+        tpusketch bundle + scorer is host-offloaded to `directory` each
+        interval; instances started after a restart merge it back in."""
+        from ..operators import tpusketch
+        tpusketch.set_checkpoint_dir(directory)
+        self._ckpt_stop = threading.Event()
+        stop = self._ckpt_stop
+
+        def loop():
+            while not stop.wait(interval):
+                tpusketch.checkpoint_all()
+
+        threading.Thread(target=loop, daemon=True,
+                         name="sketch-checkpointer").start()
+
+    def stop_checkpointer(self) -> None:
+        if self._ckpt_stop is not None:
+            self._ckpt_stop.set()
+            self._ckpt_stop = None
 
     # -- GadgetManager.GetCatalog ------------------------------------------
 
@@ -295,9 +319,13 @@ def _method(behavior, kind):
 
 
 def serve(address: str = "unix:///tmp/igtpu-agent.sock",
-          node_name: str = "node", max_workers: int = 8) -> tuple[grpc.Server, AgentServer]:
+          node_name: str = "node", max_workers: int = 8,
+          checkpoint_dir: str = "",
+          checkpoint_interval: float = 30.0) -> tuple[grpc.Server, AgentServer]:
     """Start the agent (non-blocking); returns (grpc_server, agent)."""
     agent = AgentServer(node_name=node_name)
+    if checkpoint_dir:
+        agent.start_checkpointer(checkpoint_dir, checkpoint_interval)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = {
         "GetCatalog": _method(agent.get_catalog, "unary"),

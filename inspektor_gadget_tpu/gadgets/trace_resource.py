@@ -24,6 +24,7 @@ trace_controller.go reconcile loop without client-go.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 from typing import Any, Callable
 
@@ -237,8 +238,17 @@ class TraceStore:
         self.reconciler = TraceReconciler(node_name=node_name)
         self._traces: dict[str, TraceResource] = {}
         self._mu = threading.Lock()
+        # applies are serialized end to end (lookup → reconcile → store):
+        # concurrent RPC workers racing the same name must not interleave,
+        # or a losing 'already started' apply overwrites the winner's
+        # Started record. Reads (get/list) stay on the cheap _mu only.
+        self._apply_mu = threading.Lock()
 
     def apply(self, doc: dict) -> dict:
+        with self._apply_mu:
+            return self._apply_locked(doc)
+
+    def _apply_locked(self, doc: dict) -> dict:
         incoming = trace_from_doc(doc)
         if not incoming.name:
             raise ValueError("trace document has no metadata.name")
@@ -285,14 +295,15 @@ class TraceStore:
     def delete(self, name: str) -> bool:
         """Finalizer semantics (ref: trace_controller.go finalizers): a
         still-running trace is stopped before the resource goes away."""
-        with self._mu:
-            trace = self._traces.pop(name, None)
-        if trace is None:
-            return False
-        if trace.name in self.reconciler.active():
-            trace.annotations[OPERATION_ANNOTATION] = "stop"
-            self.reconciler.reconcile(trace)
-        return True
+        with self._apply_mu:
+            with self._mu:
+                trace = self._traces.pop(name, None)
+            if trace is None:
+                return False
+            if trace.name in self.reconciler.active():
+                trace.annotations[OPERATION_ANNOTATION] = "stop"
+                self.reconciler.reconcile(trace)
+            return True
 
 
 class TraceWatcher:
@@ -340,19 +351,27 @@ class TraceWatcher:
                 continue  # node filter (ref: :172-175)
             name = doc.get("metadata", {}).get("name", "")
             try:
-                updated = self.store.apply(doc)
+                applied = self.store.apply(doc)
+                status = applied.get("status", {})
+                new_annotations = applied["metadata"]["annotations"]
             except Exception as e:
-                updated = dict(doc)
-                updated.setdefault("status", {})["operationError"] = str(e)
-                updated["metadata"] = {
-                    **doc.get("metadata", {}),
-                    "annotations": {k: v for k, v in annotations.items()
-                                    if k != OPERATION_ANNOTATION}}
+                status = {**(doc.get("status") or {}),
+                          "operationError": str(e)}
+                new_annotations = {k: v for k, v in annotations.items()
+                                   if k != OPERATION_ANNOTATION}
+            # write back onto the POLLED doc: apiserver updates need the
+            # original metadata (resourceVersion, namespace, labels, ...)
+            # intact or the PUT is rejected and the annotation re-fires
+            updated = {**doc,
+                       "metadata": {**doc.get("metadata", {}),
+                                    "annotations": new_annotations},
+                       "status": status}
             try:
                 self.client.send(self._path(name), updated, method="PUT")
                 served += 1
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — keep reconciling others
+                logging.getLogger("ig-tpu.tracewatcher").warning(
+                    "status writeback for %s failed: %s", name, e)
         return served
 
     def start(self) -> None:
@@ -362,7 +381,10 @@ class TraceWatcher:
 
         def loop():
             while not self._stop.is_set():
-                self.poll_once()
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — the loop must survive
+                    pass
                 self._stop.wait(self.interval)
 
         self._thread = threading.Thread(target=loop, daemon=True,

@@ -17,7 +17,9 @@ qnames, `trace tcp` counts flows, with zero per-gadget code.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
+from pathlib import Path
 from typing import Any, Callable
 
 import numpy as np
@@ -55,6 +57,43 @@ class SketchSummary:
     anomaly: dict[int, float] | None = None  # mntns-slot → score
     epoch: int = 0
     names: dict[int, str] = dataclasses.field(default_factory=dict)  # key32 → label
+
+
+# -- checkpoint/resume plumbing ---------------------------------------------
+# The agent points this at --checkpoint-dir; every enabled instance then
+# resumes from (bundle_merge) and periodically saves to
+# <dir>/<category>-<gadget>[-scorer].npz — the role pinned BPF maps play for
+# the reference's daemon restarts (pkg/gadgets/helpers.go:36).
+
+_ckpt_dir: Path | None = None
+_live: dict[str, "TpuSketchInstance"] = {}  # run_id → enabled instance
+_live_mu = threading.Lock()
+
+
+def set_checkpoint_dir(path: str | Path | None) -> None:
+    global _ckpt_dir
+    _ckpt_dir = Path(path) if path else None
+
+
+def checkpoint_dir() -> Path | None:
+    return _ckpt_dir
+
+
+def live_instances() -> list["TpuSketchInstance"]:
+    with _live_mu:
+        return list(_live.values())
+
+
+def checkpoint_all() -> int:
+    """Save every live sketch instance; returns how many were saved."""
+    saved = 0
+    for inst in live_instances():
+        try:
+            inst.checkpoint()
+            saved += 1
+        except Exception:  # noqa: BLE001 — one bad save must not stop the rest
+            pass
+    return saved
 
 
 class TpuSketch(Operator):
@@ -153,6 +192,12 @@ class TpuSketchInstance(OperatorInstance):
         from ..gadgets.top.sketch import SketchStatsSource
         self._stats = SketchStatsSource(ctx.run_id, ctx.desc.full_name)
         self._stats.register()
+        # checkpoint/resume: keyed by gadget identity so a restarted run
+        # (new run_id) finds its predecessor's state
+        self._ckpt_key = ctx.desc.full_name.replace("/", "-")
+        self._resume()
+        with _live_mu:
+            _live[ctx.run_id] = self
 
     # the columnar hot path -------------------------------------------------
 
@@ -294,6 +339,51 @@ class TpuSketchInstance(OperatorInstance):
         if self.enabled:
             self.harvest()
             self._stats.unregister()
+            if _ckpt_dir is not None:
+                try:
+                    self.checkpoint()
+                except Exception:  # noqa: BLE001 — shutdown save best-effort
+                    pass
+            with _live_mu:
+                _live.pop(self.ctx.run_id, None)
+
+    # checkpoint/resume -----------------------------------------------------
+
+    def _resume(self) -> None:
+        """Merge a prior checkpoint into the fresh state (bundle_merge keeps
+        absorb semantics; a config change shows up as a treedef/leaf
+        mismatch and falls back to fresh)."""
+        if _ckpt_dir is None:
+            return
+        from ..ops.sketches import bundle_merge
+        from ..utils.checkpoint import load_pytree
+        base = _ckpt_dir / self._ckpt_key
+        # broad catch: any unreadable checkpoint (missing, config mismatch,
+        # torn zip — np.load raises BadZipFile, not OSError) means fresh
+        # state, never a refusal to start
+        try:
+            prior = load_pytree(base, like=self.bundle)
+            self.bundle = bundle_merge(self.bundle, prior)
+        except Exception:  # noqa: BLE001
+            pass
+        if self.scorer is not None:
+            try:
+                self.scorer = load_pytree(
+                    Path(str(base) + "-scorer"), like=self.scorer)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def checkpoint(self) -> None:
+        """Host-offload + save current state. Two concurrent runs of the
+        same gadget share the key (last writer wins) — merge-on-resume
+        still never loses the surviving writer's counts."""
+        if _ckpt_dir is None:
+            return
+        from ..utils.checkpoint import save_pytree
+        base = _ckpt_dir / self._ckpt_key
+        save_pytree(base, self.bundle)
+        if self.scorer is not None:
+            save_pytree(Path(str(base) + "-scorer"), self.scorer)
 
     # display helpers -------------------------------------------------------
 

@@ -12,6 +12,8 @@ via bundle_merge exactly like live state).
 from __future__ import annotations
 
 import json
+import os
+import threading
 from pathlib import Path
 
 import jax
@@ -19,15 +21,28 @@ import numpy as np
 
 
 def save_pytree(path: str | Path, tree) -> None:
+    """Atomic save: a crash mid-write (the exact scenario resume exists
+    for) must never leave a torn .npz that poisons the next start — write
+    to temp names, then rename both."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
-    np.savez_compressed(str(path.with_suffix(".npz")), **arrays)
-    path.with_suffix(".json").write_text(json.dumps({
+    # unique temp names: concurrent savers of the same key (checkpointer
+    # thread vs run-teardown, or two runs sharing a key) must each write
+    # their own file — interleaved writes into one shared .tmp would
+    # install a torn archive, the exact failure atomicity is for
+    tag = f".{os.getpid()}.{threading.get_ident()}.tmp"
+    tmp_npz = path.with_suffix(f".npz{tag}")
+    with open(tmp_npz, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    tmp_json = path.with_suffix(f".json{tag}")
+    tmp_json.write_text(json.dumps({
         "n_leaves": len(leaves),
         "treedef": str(treedef),
     }))
+    os.replace(tmp_npz, path.with_suffix(".npz"))
+    os.replace(tmp_json, path.with_suffix(".json"))
 
 
 def load_pytree(path: str | Path, like):
