@@ -184,6 +184,13 @@ def _serve_loop(args) -> int:
                 from ..operators.operators import ensure_initialized
                 with_fanotify_discovery()(
                     ensure_initialized("localmanager").cc)
+        if args.kube_api:
+            # IP→pod/service enrichment off the same apiserver
+            # (ref: kubeipresolver.go:62-156 inventory cache)
+            from ..operators.operators import get as get_operator
+            from ..utils.k8s import KubeClient
+            get_operator("kubeipresolver").use_kube_client(
+                KubeClient(server=args.kube_api))
         if args.pod_manifest or args.kube_api:
             # pod-informer discovery feeding the localmanager collection
             # (ref: WithPodInformer wired in main.go's serve path)

@@ -73,6 +73,11 @@ class AgentServer:
         if self._ckpt_stop is not None:
             self._ckpt_stop.set()
             self._ckpt_stop = None
+            # final save: a clean SIGTERM must not drop the last interval's
+            # counts for still-running gadget runs (their post_gadget_run
+            # never fires — the stream threads die with the process)
+            from ..operators import tpusketch
+            tpusketch.checkpoint_all()
 
     # -- GadgetManager.GetCatalog ------------------------------------------
 

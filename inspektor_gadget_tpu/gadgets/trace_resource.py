@@ -266,7 +266,11 @@ class TraceStore:
                 if existing.name in self.reconciler.active():
                     existing.status.operation_error = (
                         "spec update rejected: trace is running (stop first)")
+                    # consume the operation annotation: this branch skips
+                    # reconcile (which normally pops it), and a writeback
+                    # with it intact would re-fire the rejected op forever
                     existing.annotations.update(incoming.annotations)
+                    existing.annotations.pop(OPERATION_ANNOTATION, None)
                     return trace_to_doc(existing)
                 existing.spec = incoming.spec
             # operations arrive as annotations on the stored resource

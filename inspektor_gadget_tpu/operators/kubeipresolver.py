@@ -3,9 +3,10 @@
 Reference contract: pkg/operators/kubeipresolver — a polled cluster
 inventory cache (k8sInventoryCache, kubeipresolver.go:62-156) maps event
 IPs to pod/service names for gadgets exposing KubeNetworkInformation
-(:46-59). Here the inventory backend is pluggable: a static inventory map
-(tests/agents), /etc/hosts, and — when a kube API is reachable — a
-poll hook with the same refresh cadence.
+(:46-59). Inventory backends, most to least capable: `kube_inventory`
+polls pods **and services** through a KubeClient into the operator's TTL
+cache (the reference's path); a static inventory map (tests/agents);
+/etc/hosts as the no-cluster fallback.
 """
 
 from __future__ import annotations
@@ -37,6 +38,40 @@ def hosts_inventory(path: str = "/etc/hosts") -> dict[str, tuple[str, str]]:
     return out
 
 
+def kube_inventory(client: Any) -> Callable[[], dict[str, tuple[str, str]]]:
+    """ip → (kind, namespace/name) polled off the apiserver — pods AND
+    services, the reference's inventory (kubeipresolver.go:62-156 polls
+    both into the cache). Pods win conflicts (more specific than a
+    service VIP); headless services ('None') are skipped."""
+
+    def poll() -> dict[str, tuple[str, str]]:
+        out: dict[str, tuple[str, str]] = {}
+        for svc in client.list_services():
+            meta = svc.get("metadata", {})
+            name = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+            spec = svc.get("spec", {})
+            ips = [ip for ip in (spec.get("clusterIPs") or []) if ip]
+            head = spec.get("clusterIP", "")
+            if head and head not in ips:
+                ips.append(head)
+            for ip in ips:
+                if ip != "None":
+                    out[ip] = ("svc", name)
+        for pod in client.list_pods():
+            meta = pod.get("metadata", {})
+            name = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+            status = pod.get("status", {})
+            ips = [p.get("ip") for p in status.get("podIPs", []) if p.get("ip")]
+            head = status.get("podIP", "")
+            if head and head not in ips:
+                ips.append(head)
+            for ip in ips:
+                out[ip] = ("pod", name)
+        return out
+
+    return poll
+
+
 class KubeIPResolver(Operator):
     name = "kubeipresolver"
 
@@ -45,6 +80,18 @@ class KubeIPResolver(Operator):
         self._cache: dict[str, tuple[str, str]] = {}
         self._last = 0.0
         self._mu = threading.Lock()
+        self.refresh_interval = REFRESH_INTERVAL
+
+    def use_kube_client(self, client: Any,
+                        refresh_interval: float | None = None) -> None:
+        """Switch the inventory to the cluster poll (agent wiring when
+        --kube-api is configured)."""
+        with self._mu:
+            self._inventory_fn = kube_inventory(client)
+            self._cache = {}
+            self._last = 0.0
+            if refresh_interval is not None:
+                self.refresh_interval = refresh_interval
 
     def instance_params(self) -> ParamDescs:
         return ParamDescs([
@@ -59,11 +106,24 @@ class KubeIPResolver(Operator):
         return bool(fields & {"saddr", "daddr", "remote", "remoteaddr", "localaddr"})
 
     def lookup(self, ip: str) -> tuple[str, str] | None:
+        # the poll can be two cluster-wide HTTP lists (seconds on a big
+        # cluster): never hold _mu across it — one caller claims the
+        # refresh, every other enrich() keeps reading the stale cache
         now = time.monotonic()
         with self._mu:
-            if now - self._last > REFRESH_INTERVAL:
-                self._cache = self._inventory_fn()
+            claimed = now - self._last > self.refresh_interval
+            if claimed:
                 self._last = now
+            fn = self._inventory_fn
+        if claimed:
+            try:
+                fresh = fn()
+            except Exception:  # noqa: BLE001 — apiserver blip: keep stale
+                fresh = None
+            if fresh is not None:
+                with self._mu:
+                    self._cache = fresh
+        with self._mu:
             return self._cache.get(ip)
 
     def set_inventory(self, inventory: dict[str, tuple[str, str]]) -> None:

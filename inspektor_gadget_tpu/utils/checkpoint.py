@@ -28,6 +28,10 @@ def save_pytree(path: str | Path, tree) -> None:
     path.parent.mkdir(parents=True, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    # the treedef travels INSIDE the archive so the checkpoint is one file
+    # + one rename — a crash can never pair a new .npz with stale metadata
+    arrays["__treedef__"] = np.frombuffer(
+        str(treedef).encode(), dtype=np.uint8)
     # unique temp names: concurrent savers of the same key (checkpointer
     # thread vs run-teardown, or two runs sharing a key) must each write
     # their own file — interleaved writes into one shared .tmp would
@@ -36,13 +40,15 @@ def save_pytree(path: str | Path, tree) -> None:
     tmp_npz = path.with_suffix(f".npz{tag}")
     with open(tmp_npz, "wb") as f:
         np.savez_compressed(f, **arrays)
-    tmp_json = path.with_suffix(f".json{tag}")
-    tmp_json.write_text(json.dumps({
-        "n_leaves": len(leaves),
-        "treedef": str(treedef),
-    }))
     os.replace(tmp_npz, path.with_suffix(".npz"))
-    os.replace(tmp_json, path.with_suffix(".json"))
+    # sidecar kept for human inspection only; load trusts the archive
+    try:
+        path.with_suffix(".json").write_text(json.dumps({
+            "n_leaves": len(leaves),
+            "treedef": str(treedef),
+        }))
+    except OSError:
+        pass
 
 
 def load_pytree(path: str | Path, like):
@@ -51,17 +57,21 @@ def load_pytree(path: str | Path, like):
     tell a bundle from a scorer with the same number of arrays, and a
     silent structure swap corrupts resumed state."""
     path = Path(path)
+    saved_treedef = None
     with np.load(str(path.with_suffix(".npz"))) as z:
-        leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+        if "__treedef__" in z.files:
+            saved_treedef = bytes(z["__treedef__"]).decode()
+        n = len([k for k in z.files if k.startswith("leaf_")])
+        leaves = [z[f"leaf_{i}"] for i in range(n)]
     like_leaves, treedef = jax.tree_util.tree_flatten(like)
-    meta_path = path.with_suffix(".json")
-    if meta_path.exists():
-        meta = json.loads(meta_path.read_text())
-        saved_treedef = meta.get("treedef")
-        if saved_treedef is not None and saved_treedef != str(treedef):
-            raise ValueError(
-                f"checkpoint structure mismatch:\n  saved: {saved_treedef}\n"
-                f"  expected: {treedef}")
+    if saved_treedef is None:  # legacy checkpoints: sidecar metadata
+        meta_path = path.with_suffix(".json")
+        if meta_path.exists():
+            saved_treedef = json.loads(meta_path.read_text()).get("treedef")
+    if saved_treedef is not None and saved_treedef != str(treedef):
+        raise ValueError(
+            f"checkpoint structure mismatch:\n  saved: {saved_treedef}\n"
+            f"  expected: {treedef}")
     if len(leaves) != len(like_leaves):
         raise ValueError(
             f"checkpoint has {len(leaves)} leaves, expected {len(like_leaves)}")

@@ -1,0 +1,132 @@
+"""KubeIPResolver cluster inventory against a fake apiserver.
+
+Reference tier: pkg/operators/kubeipresolver/kubeipresolver.go:62-156 —
+k8sInventoryCache polls pods AND services into a TTL cache; events'
+addresses get pod/service names attached. Here the same poll runs through
+KubeClient against an in-process HTTP apiserver whose state the tests
+mutate to prove cache-refresh semantics.
+"""
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from inspektor_gadget_tpu.operators.kubeipresolver import (
+    KubeIPResolver,
+    kube_inventory,
+)
+from inspektor_gadget_tpu.utils.k8s import KubeClient
+
+
+def _pod(ns, name, *ips):
+    return {"metadata": {"name": name, "namespace": ns},
+            "spec": {},
+            "status": {"podIP": ips[0] if ips else "",
+                       "podIPs": [{"ip": ip} for ip in ips]}}
+
+
+def _svc(ns, name, *ips):
+    return {"metadata": {"name": name, "namespace": ns},
+            "spec": {"clusterIP": ips[0] if ips else "",
+                     "clusterIPs": list(ips)}}
+
+
+class _FakeApi(BaseHTTPRequestHandler):
+    pods: list = []
+    services: list = []
+
+    def do_GET(self):
+        if "/services" in self.path:
+            body = {"items": _FakeApi.services}
+        elif "/pods" in self.path:
+            body = {"items": _FakeApi.pods}
+        else:
+            self.send_error(404)
+            return
+        data = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def fake_api():
+    server = HTTPServer(("127.0.0.1", 0), _FakeApi)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    _FakeApi.pods = [_pod("prod", "web-0", "10.0.0.5"),
+                     _pod("prod", "db-0", "10.0.0.6", "fd00::6")]
+    _FakeApi.services = [_svc("prod", "web", "10.96.0.10"),
+                         _svc("prod", "headless", "None")]
+    yield server
+    server.shutdown()
+
+
+def _url(server):
+    return f"http://127.0.0.1:{server.server_port}"
+
+
+def test_inventory_polls_pods_and_services(fake_api):
+    inv = kube_inventory(KubeClient(server=_url(fake_api)))()
+    assert inv["10.0.0.5"] == ("pod", "prod/web-0")
+    assert inv["10.0.0.6"] == ("pod", "prod/db-0")
+    assert inv["fd00::6"] == ("pod", "prod/db-0")  # dual-stack secondary IP
+    assert inv["10.96.0.10"] == ("svc", "prod/web")
+    assert "None" not in inv  # headless services skipped
+
+
+def test_pod_wins_ip_conflict(fake_api):
+    _FakeApi.services = [_svc("prod", "vip", "10.0.0.5")]
+    inv = kube_inventory(KubeClient(server=_url(fake_api)))()
+    assert inv["10.0.0.5"] == ("pod", "prod/web-0")
+
+
+def test_resolver_enriches_via_cluster_inventory(fake_api):
+    op = KubeIPResolver()
+    op.use_kube_client(KubeClient(server=_url(fake_api)))
+
+    @dataclasses.dataclass
+    class NetEv:
+        saddr: str = ""
+        daddr: str = ""
+
+    inst = op.instantiate(None, None, op.instance_params().to_params())
+    ev = NetEv(saddr="10.0.0.5:443", daddr="10.96.0.10")
+    inst.enrich(ev)
+    assert "pod/prod/web-0" in ev.saddr
+    assert "svc/prod/web" in ev.daddr
+
+
+def test_cache_refresh_picks_up_new_pods(fake_api):
+    op = KubeIPResolver()
+    op.use_kube_client(KubeClient(server=_url(fake_api)),
+                       refresh_interval=0.0)
+    assert op.lookup("10.0.0.99") is None
+    _FakeApi.pods.append(_pod("prod", "new-0", "10.0.0.99"))
+    assert op.lookup("10.0.0.99") == ("pod", "prod/new-0")
+
+
+def test_stale_cache_within_ttl(fake_api):
+    op = KubeIPResolver()
+    op.use_kube_client(KubeClient(server=_url(fake_api)),
+                       refresh_interval=300.0)
+    assert op.lookup("10.0.0.5") == ("pod", "prod/web-0")
+    _FakeApi.pods = []  # cluster changed, but TTL hasn't expired
+    assert op.lookup("10.0.0.5") == ("pod", "prod/web-0")
+
+
+def test_apiserver_blip_keeps_stale_cache(fake_api):
+    op = KubeIPResolver()
+    client = KubeClient(server=_url(fake_api))
+    op.use_kube_client(client, refresh_interval=0.0)
+    assert op.lookup("10.0.0.5") == ("pod", "prod/web-0")
+    client.server = "http://127.0.0.1:1"  # unreachable
+    assert op.lookup("10.0.0.5") == ("pod", "prod/web-0")  # stale, not lost
