@@ -416,6 +416,13 @@ def cmd_run(args) -> int:
 
     cols = ctx.columns
     filters = parse_filters(args.filter, cols) if args.filter and cols else []
+    if filters and not args.remote:
+        # push filters into the gadget's batch loop: rows that can't match
+        # are dropped columnar and never become Python objects (the
+        # display-path hot-loop contract; batch-capable gadgets set
+        # display_filters_applied and on_event skips the re-check)
+        extra["display_filters"] = filters
+        extra["display_columns"] = cols
     if cols is not None:
         from ..environment import Environment, current
         if current() == Environment.LOCAL:
@@ -429,7 +436,8 @@ def cmd_run(args) -> int:
 
     def on_event(ev):
         nonlocal printed_header
-        if filters and not match_event(ev, filters, cols):
+        if (filters and not extra.get("display_filters_applied")
+                and not match_event(ev, filters, cols)):
             return
         if args.output == "json":
             out.write(cols.to_json(ev) + "\n")

@@ -78,6 +78,23 @@ def match_event(event: Any, filters: Iterable[FilterSpec], columns: Columns) -> 
     return all(_compare(columns.get(f.column).value(event), f) for f in filters)
 
 
+def numeric_col_mask(arr: np.ndarray, f: FilterSpec) -> np.ndarray | None:
+    """Vectorized compare of one numeric filter against a column, honoring
+    the row path's semantics; returns None when the caller must fall back
+    to row-wise matching (value unrepresentable in the dtype — including
+    OverflowError from out-of-range ints on numpy 2.x — or a non-canonical
+    eq numeral like '07', which the row path string-compares)."""
+    try:
+        val = np.asarray(f.value).astype(arr.dtype)
+    except (ValueError, OverflowError):
+        return None
+    if f.op == "eq" and str(val.item()) != f.value:
+        return None
+    m = {"eq": arr == val, "gt": arr > val, "ge": arr >= val,
+         "lt": arr < val, "le": arr <= val}[f.op]
+    return ~m if f.negate else m
+
+
 def columnar_mask(
     batch: Mapping[str, np.ndarray],
     filters: Iterable[FilterSpec],
@@ -108,23 +125,15 @@ def columnar_mask(
             # as the row-wise path
             m = np.asarray([bool(f._regex.search(str(v))) for v in arr])
         else:
-            try:
-                val = np.asarray(f.value).astype(arr.dtype)
-            except ValueError:
-                # unparseable comparison value: row path compares str(v) for
-                # eq and returns False for ordered ops — mirror that
-                if f.op == "eq":
-                    m = np.asarray([str(v) == f.value for v in arr])
-                else:
-                    m = np.zeros(n, dtype=bool)
-                mask &= ~m if f.negate else m
+            m = numeric_col_mask(arr, f)
+            if m is not None:
+                mask &= m
                 continue
-            m = {
-                "eq": arr == val,
-                "gt": arr > val,
-                "ge": arr >= val,
-                "lt": arr < val,
-                "le": arr <= val,
-            }[f.op]
+            # unrepresentable comparison value: row path compares str(v)
+            # for eq and returns False for ordered ops — mirror that
+            if f.op == "eq":
+                m = np.asarray([str(v) == f.value for v in arr])
+            else:
+                m = np.zeros(n, dtype=bool)
         mask &= ~m if f.negate else m
     return mask

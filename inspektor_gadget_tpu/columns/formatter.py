@@ -8,7 +8,8 @@ column metadata, auto-scale in textcolumns.go AdjustWidthsToScreen).
 
 from __future__ import annotations
 
-from typing import Any, Iterable
+import operator
+from typing import Any, Iterable, Mapping
 
 from .columns import Column, Columns
 from .ellipsis import truncate
@@ -64,11 +65,38 @@ class TextFormatter:
             cells.append(self._cell(c, name))
         return self.divider.join(cells).rstrip()
 
+    def _compile_fast(self) -> list:
+        """Precompute per-column (getter, width, align, ...) so the
+        per-event path (the display hot loop) does no sorted() rebuild,
+        no field-string split, no method dispatch."""
+        specs = []
+        for c in self.columns.visible():
+            get = c.extractor or operator.attrgetter(c.field)
+            specs.append((get, c.precision, self._widths[c.name],
+                          c.align == "right", c.ellipsis))
+        self._fast = specs
+        return specs
+
     def format_event(self, event: Any) -> str:
-        cells = [
-            self._cell(c, c.format_value(c.value(event)))
-            for c in self.columns.visible()
-        ]
+        if isinstance(event, Mapping):  # remote JSON rows: generic path
+            cells = [self._cell(c, c.format_value(c.value(event)))
+                     for c in self.columns.visible()]
+            return self.divider.join(cells).rstrip()
+        specs = getattr(self, "_fast", None) or self._compile_fast()
+        cells = []
+        for get, precision, w, right, ell in specs:
+            v = get(event)
+            if v is None:
+                text = ""
+            elif isinstance(v, bool):
+                text = "true" if v else "false"
+            elif isinstance(v, float):
+                text = f"{v:.{precision}f}"
+            else:
+                text = str(v)
+            if len(text) > w:
+                text = truncate(text, w, ell)
+            cells.append(text.rjust(w) if right else text.ljust(w))
         return self.divider.join(cells).rstrip()
 
     def format_table(self, events: Iterable[Any]) -> str:

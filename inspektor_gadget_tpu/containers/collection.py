@@ -39,6 +39,7 @@ class ContainerCollection:
         self._by_mntns: dict[int, Container] = {}
         self._by_netns: dict[int, list[Container]] = {}
         self._removed: dict[int, tuple[float, Container]] = {}  # mntns → (t, c)
+        self._last_gc = 0.0
         self._subs: dict[object, Callable[[PubSubEvent], None]] = {}
         self._enrichers: list[Callable[[Container], bool]] = []
         self._initialized = False
@@ -107,7 +108,13 @@ class ContainerCollection:
             fn(ev)
 
     def _gc_removed(self) -> None:
+        # amortized: this runs on EVERY lookup miss (the display hot loop
+        # when no container matches) — a full sweep per event would cost
+        # more than the lookup itself
         now = time.monotonic()
+        if now - self._last_gc < 0.5:
+            return
+        self._last_gc = now
         stale = [k for k, (t, _) in self._removed.items() if now - t > REMOVED_CACHE_TTL]
         for k in stale:
             del self._removed[k]
@@ -132,7 +139,11 @@ class ContainerCollection:
                 return c
             self._gc_removed()
             entry = self._removed.get(mntns)
-            return entry[1] if entry else None
+            # TTL checked at hit time: the sweep above is amortized, so an
+            # entry can outlive its window on disk but must not be served
+            if entry and time.monotonic() - entry[0] <= REMOVED_CACHE_TTL:
+                return entry[1]
+            return None
 
     def lookup_by_netns(self, netns: int) -> list[Container]:
         with self._mu:

@@ -226,11 +226,27 @@ class SourceTraceGadget:
     # netlink exits, once from the ptrace delivery stop)
     attach_replaces_main: bool = False
 
+    # event-field → wire-column mapping for the vectorized display path;
+    # subclasses extend when they expose more pass-through numeric fields
+    display_wire_cols: dict[str, str] = {
+        "pid": "pid", "ppid": "ppid", "uid": "uid",
+        "mountnsid": "mntns", "timestamp": "ts",
+    }
+
     def __init__(self, ctx: GadgetContext):
         self.ctx = ctx
         self._event_handler: Callable[[Any], None] | None = None
         self._batch_handler: Callable[[EventBatch], None] | None = None
         self._mntns_filter: set[int] | None = None
+        # display filters pushed down by the CLI (ctx.extra) so the hot
+        # loop only materializes surviving rows (ref: the tracer hot-loop
+        # contract, trace/exec/tracer/tracer.go:134-188 — filter before
+        # build, never after)
+        self._display_filters = list(ctx.extra.get("display_filters") or [])
+        self._display_columns = ctx.extra.get("display_columns")
+        self._key_cache: dict[int, str] = {}
+        if self._display_filters:
+            ctx.extra["display_filters_applied"] = True
         self._is_native = False
         # per-container attached sources (task: Attacher path for ptrace
         # gadgets — ref localmanager.go:230-260 per-container attach)
@@ -429,8 +445,7 @@ class SourceTraceGadget:
                     if batch.count and self._batch_handler is not None:
                         self._batch_handler(batch)
                     if batch.count and self._event_handler is not None:
-                        for i in range(batch.count):
-                            self._event_handler(self.decode_row(batch, i))
+                        self._emit_display_rows(batch)
                 if got == 0:
                     if self._source_done():
                         break  # e.g. traced command exited, ring drained
@@ -505,6 +520,122 @@ class SourceTraceGadget:
 
     def decode_row(self, batch: EventBatch, i: int) -> Any:
         raise NotImplementedError
+
+    def decode_rows(self, batch: EventBatch, idx) -> list:
+        """Decode a set of row indices; subclasses may vectorize."""
+        return [self.decode_row(batch, int(i)) for i in idx]
+
+    def _display_batch_mask(
+            self, batch: EventBatch) -> tuple[np.ndarray | None, list]:
+        """Split the pushed-down filters into (columnar prefilter mask,
+        residual row filters). The mask is a NECESSARY condition — exact
+        for numeric wire columns, a prefix test for comm (the wire carries
+        an 8-byte prefix; rows with no comm bytes pass through to the
+        residual exact check, since their display comm resolves from the
+        vocab instead)."""
+        n = batch.count
+        mask: np.ndarray | None = None
+        residual: list = []
+        for f in self._display_filters:
+            wire = self.display_wire_cols.get(f.column)
+            m = None
+            if wire is not None and wire in batch.cols and f.op != "re":
+                from ..columns.filter import numeric_col_mask
+                m = numeric_col_mask(batch.cols[wire][:n], f)
+                if m is None:  # unrepresentable/non-canonical: row path
+                    residual.append(f)
+                    continue
+            elif (f.column == "comm" and f.op == "eq" and not f.negate
+                  and batch.comm is not None):
+                raw = f.value.encode()
+                # the 8-byte comm prefix is one u64 word: an exact match
+                # (name shorter than the field, NUL-padded) is a single
+                # vector compare
+                comm_words = batch.comm[:n].reshape(n, 8).view(np.uint64)[:, 0]
+                if len(raw) < 8:
+                    want = np.frombuffer(raw.ljust(8, b"\0"),
+                                         dtype=np.uint64)[0]
+                    m = comm_words == want
+                    exact = True
+                else:  # prefix-only test; residual confirms the full name
+                    want = np.frombuffer(raw[:8], dtype=np.uint64)[0]
+                    m = comm_words == want
+                    exact = False
+                # comm-less rows resolve their name from the vocab at
+                # decode time — they need the residual exact check; when
+                # none exist and the word compare is exact, the mask alone
+                # decides and survivors skip re-matching
+                no_comm = comm_words == 0
+                if not exact or no_comm.any():
+                    m = m | no_comm
+                    residual.append(f)
+            if m is None:
+                residual.append(f)
+            else:
+                mask = m if mask is None else mask & m
+        return mask, residual
+
+    def _emit_display_rows(self, batch: EventBatch) -> None:
+        handler = self._event_handler
+        if not self._display_filters:
+            for ev in self.decode_rows(batch, range(batch.count)):
+                handler(ev)
+            return
+        mask, residual = self._display_batch_mask(batch)
+        idx = np.flatnonzero(mask) if mask is not None else range(batch.count)
+        if residual:
+            from ..columns import match_event
+            cols = self._display_columns or self.ctx.columns
+            for ev in self.decode_rows(batch, idx):
+                if match_event(ev, residual, cols):
+                    handler(ev)
+        else:
+            for ev in self.decode_rows(batch, idx):
+                handler(ev)
+
+    def resolve_keys_bulk(self, keys: np.ndarray) -> list[str]:
+        """Resolve many key hashes with one native crossing PER SOURCE —
+        never a per-key ctypes call (an unknown high-cardinality key would
+        otherwise cost ~15us each in fallback lookups). Keys no source
+        knows resolve to ""."""
+        keys64 = np.ascontiguousarray(keys, dtype=np.uint64)
+        n = keys64.size
+        vals: list[str] = [""] * n
+        if n == 0:
+            return vals
+        cur = self._current_source
+        sources = ([cur] if cur is not None else []) + [
+            s for s in self._active_sources() if s is not cur]
+        pending = np.arange(n)
+        for src in sources:
+            if pending.size == 0:
+                break
+            if hasattr(src, "vocab_lookup_batch"):
+                got = src.vocab_lookup_batch(keys64[pending])
+            else:
+                got = [src.vocab_lookup(int(k)) for k in keys64[pending]]
+            still = []
+            for idx, v in zip(pending.tolist(), got):
+                if v:
+                    vals[idx] = v
+                else:
+                    still.append(idx)
+            pending = np.asarray(still, dtype=np.int64)
+        return vals
+
+    def resolve_key_cached(self, key_hash: int) -> str:
+        """Memoized resolve_key for display decode loops: the vocab is a
+        ctypes round-trip per call, but key hashes repeat constantly
+        (comms, argvs). Bounded: cleared when it hits 64k entries (real
+        captures can mint unbounded distinct args strings)."""
+        cache = self._key_cache
+        v = cache.get(key_hash)
+        if v is None:
+            v = self.resolve_key(key_hash)
+            if len(cache) >= 65536:
+                cache.clear()
+            cache[key_hash] = v
+        return v
 
     def resolve_key(self, key_hash: int) -> str:
         # prefer the source that produced the batch being decoded; fall

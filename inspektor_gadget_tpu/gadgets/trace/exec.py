@@ -54,6 +54,46 @@ class TraceExec(SourceTraceGadget):
             args=args,
         )
 
+    def decode_rows(self, batch, idx) -> list:
+        """Bulk decode: one fancy-index + .tolist() per column instead of
+        per-row numpy scalar extraction (the display-path hot loop)."""
+        c = batch.cols
+        sel = np.asarray(idx, dtype=np.int64)
+        if sel.size == 0:
+            return []
+        ts = c["ts"][sel].tolist()
+        mnt = c["mntns"][sel].tolist()
+        pid = c["pid"][sel].tolist()
+        ppid = c["ppid"][sel].tolist()
+        uid = c["uid"][sel].tolist()
+        kh = c["key_hash"][sel].tolist()
+        comm_rows = (batch.comm[sel].tobytes()
+                     if batch.comm is not None else None)
+        # argv strings are per-event-unique: resolve them in ONE native
+        # crossing instead of a ctypes call per row
+        aux1_arr = c["aux1"][sel]
+        need = np.flatnonzero((c["kind"][sel] == 1) & (aux1_arr != 0))
+        args_list = [""] * sel.size
+        if need.size:
+            for j, v in zip(need.tolist(),
+                            self.resolve_keys_bulk(aux1_arr[need])):
+                args_list[j] = v
+        resolve = self.resolve_key_cached
+        out = []
+        for j in range(sel.size):
+            comm = ""
+            if comm_rows is not None:
+                raw = comm_rows[j * 8:(j + 1) * 8]
+                comm = raw.split(b"\0", 1)[0].decode("utf-8", "replace")
+            out.append(ExecEvent(
+                timestamp=ts[j], mountnsid=mnt[j], pid=pid[j], ppid=ppid[j],
+                uid=uid[j],
+                comm=comm or resolve(kh[j]),
+                retval=0,
+                args=args_list[j],
+            ))
+        return out
+
 
 @register
 class TraceExecDesc(GadgetDesc):

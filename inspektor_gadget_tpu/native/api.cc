@@ -419,6 +419,20 @@ int64_t ig_vocab_lookup(uint64_t h, uint64_t key, char* out, int64_t cap) {
   return (int64_t)s->vocab().get(key, out, (size_t)cap);
 }
 
+// Batch un-hash for the display decode loop: one ctypes crossing per
+// batch instead of one per row. out is n*stride bytes; lens[i] receives
+// the copied length (0 = unknown key).
+int64_t ig_vocab_lookup_batch(uint64_t h, const uint64_t* keys, int64_t n,
+                              char* out, int64_t stride, int32_t* lens) {
+  Source* s = lookup(h);
+  if (!s || n <= 0 || stride <= 0 || !keys || !out || !lens) return -1;
+  for (int64_t i = 0; i < n; i++) {
+    lens[i] = (int32_t)s->vocab().get(keys[i], out + i * stride,
+                                      (size_t)stride);
+  }
+  return n;
+}
+
 uint64_t ig_fnv1a64(const char* s, int64_t n) {
   return fnv1a64(s, (size_t)n);
 }

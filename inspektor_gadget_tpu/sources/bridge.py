@@ -146,6 +146,10 @@ def _load_and_bind(rebuild: bool):
     lib.ig_synth_generate_folded.restype = i64
     lib.ig_vocab_lookup.argtypes = [u64, u64, ctypes.c_char_p, i64]
     lib.ig_vocab_lookup.restype = i64
+    lib.ig_vocab_lookup_batch.argtypes = [
+        u64, p64, i64, ctypes.c_char_p, i64,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.ig_vocab_lookup_batch.restype = i64
     lib.ig_sources_stats.argtypes = [p64, p32] + [p64] * 7 + [i64]
     lib.ig_sources_stats.restype = i64
     lib.ig_fanotify_supported.argtypes = []
@@ -426,3 +430,22 @@ class NativeCapture:
         buf = ctypes.create_string_buffer(256)
         n = self._lib.ig_vocab_lookup(self._h, key_hash, buf, 256)
         return buf.raw[:n].decode("utf-8", "replace") if n > 0 else ""
+
+    def vocab_lookup_batch(self, keys, stride: int = 256) -> list[str]:
+        """Un-hash many keys with ONE native crossing (the display decode
+        hot loop; per-row ctypes calls cost ~15us each)."""
+        keys64 = np.ascontiguousarray(keys, dtype=np.uint64)
+        n = keys64.size
+        if n == 0:
+            return []
+        out = ctypes.create_string_buffer(n * stride)
+        lens = np.zeros(n, dtype=np.int32)
+        r = self._lib.ig_vocab_lookup_batch(
+            self._h, _p64(keys64), n, out,
+            stride, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if r < 0:
+            return [""] * n
+        raw = out.raw
+        ls = lens.tolist()
+        return [raw[i * stride:i * stride + ls[i]].decode("utf-8", "replace")
+                if ls[i] > 0 else "" for i in range(n)]
