@@ -47,6 +47,38 @@ def generate_oci_seccomp_profile(syscalls: set[str],
     }
 
 
+def generate_seccomp_profile_cr(name: str, syscalls: set[str],
+                                namespace: str = "",
+                                default_action: str = "SCMP_ACT_ERRNO") -> str:
+    """security-profiles-operator SeccompProfile custom resource, rendered
+    as YAML (ref: gadget-collection/gadgets/advise/seccomp/gadget.go:582
+    emits both the OCI JSON and this CR shape). Hand-rolled YAML: syscall
+    names are [a-z0-9_] identifiers; the user-supplied name/namespace are
+    JSON-quoted (valid YAML scalars) against metacharacters."""
+    import json as _json
+    profile = generate_oci_seccomp_profile(syscalls, default_action)
+    lines = [
+        "apiVersion: security-profiles-operator.x-k8s.io/v1beta1",
+        "kind: SeccompProfile",
+        "metadata:",
+        f"  name: {_json.dumps(name)}",
+    ]
+    if namespace:
+        lines.append(f"  namespace: {_json.dumps(namespace)}")
+    lines += [
+        "spec:",
+        f"  defaultAction: {profile['defaultAction']}",
+        "  architectures:",
+    ]
+    lines += [f"  - {a}" for a in profile["architectures"]]
+    lines.append("  syscalls:")
+    for rule in profile["syscalls"]:
+        lines.append(f"  - action: {rule['action']}")
+        lines.append("    names:")
+        lines += [f"    - {n}" for n in rule["names"]]
+    return "\n".join(lines) + "\n"
+
+
 class AdviseSeccompProfile(PtraceAttachMixin, SourceTraceGadget):
     """Native mode records the target's ACTUAL syscall numbers from the
     ptrace stream (EV_SYSCALL aux2 high word = nr), so the generated
@@ -87,6 +119,18 @@ class AdviseSeccompProfile(PtraceAttachMixin, SourceTraceGadget):
             names = {syscall_name(nr) for nr in nrs}
             profiles[str(mntns)] = generate_oci_seccomp_profile(names)
         ctx.result = profiles
+        p = ctx.gadget_params
+        fmt = p.get("format").as_string() if "format" in p else "oci"
+        if fmt == "cr":
+            # SeccompProfile CR YAML documents, one per container
+            # (ref: gadget.go:582's CR output mode)
+            prefix = (p.get("profile-name").as_string()
+                      if "profile-name" in p else "") or "ig-seccomp"
+            docs = []
+            for mntns, nrs in sorted(self._per_container.items()):
+                docs.append(generate_seccomp_profile_cr(
+                    f"{prefix}-{mntns}", {syscall_name(nr) for nr in nrs}))
+            return "---\n".join(docs).encode()
         return (json.dumps(profiles, indent=2) + "\n").encode()
 
 
@@ -102,6 +146,11 @@ class AdviseSeccompProfileDesc(GadgetDesc):
         p = source_params()
         p.append(ParamDesc(key="profile-name", default="",
                            description="name for the generated profile"))
+        p.append(ParamDesc(key="format", default="oci",
+                           possible_values=("oci", "cr"),
+                           description="oci: runtime-spec seccomp JSON; "
+                                       "cr: SeccompProfile custom-resource "
+                                       "YAML (security-profiles-operator)"))
         p.append(ParamDesc(key="command", default="",
                            description="command to spawn and record"))
         p.append(ParamDesc(key="pid", default="0",

@@ -576,10 +576,14 @@ class SourceTraceGadget:
         return mask, residual
 
     def _emit_display_rows(self, batch: EventBatch) -> None:
+        # decode_row may return None for rows a gadget declines to surface
+        # (e.g. audit/seccomp's non-denial syscalls) — those must be
+        # skipped BEFORE filtering, not handed to match_event
         handler = self._event_handler
         if not self._display_filters:
             for ev in self.decode_rows(batch, range(batch.count)):
-                handler(ev)
+                if ev is not None:
+                    handler(ev)
             return
         mask, residual = self._display_batch_mask(batch)
         idx = np.flatnonzero(mask) if mask is not None else range(batch.count)
@@ -587,11 +591,12 @@ class SourceTraceGadget:
             from ..columns import match_event
             cols = self._display_columns or self.ctx.columns
             for ev in self.decode_rows(batch, idx):
-                if match_event(ev, residual, cols):
+                if ev is not None and match_event(ev, residual, cols):
                     handler(ev)
         else:
             for ev in self.decode_rows(batch, idx):
-                handler(ev)
+                if ev is not None:
+                    handler(ev)
 
     def resolve_keys_bulk(self, keys: np.ndarray) -> list[str]:
         """Resolve many key hashes with one native crossing PER SOURCE —

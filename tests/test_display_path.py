@@ -92,6 +92,27 @@ def test_long_comm_prefix_needs_residual():
     assert residual, "8-byte prefix match must keep the exact row check"
 
 
+def test_none_decodes_skip_residual_filter():
+    """Gadgets whose decode_row declines rows (returns None — e.g.
+    audit/seccomp's non-denial syscalls) must not feed None into the
+    residual match_event when a filter is pushed down."""
+    desc = get("audit", "seccomp")
+    params = desc.params().to_params()
+    params.set("source", "pysynthetic")
+    cols = desc.columns()
+    extra = {"display_filters": parse_filters("syscall:openat", cols),
+             "display_columns": cols}
+    ctx = GadgetContext(desc, gadget_params=params, extra=extra)
+    g = desc.new_instance(ctx)
+    g.source = g._make_source()
+    batch = g.source.generate(512)
+    g._current_source = g.source
+    shown = []
+    g.set_event_handler(shown.append)
+    g._emit_display_rows(batch)  # must not raise on None rows
+    assert all(e is not None for e in shown)
+
+
 def test_bulk_key_resolution_matches_scalar():
     desc = get("trace", "exec")
     params = desc.params().to_params()
