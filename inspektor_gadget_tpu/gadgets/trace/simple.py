@@ -47,14 +47,16 @@ class _MountAttachMixin(NsRefcountAttachMixin):
     marking the container's root mount AND its submounts (volumes,
     emptyDirs) via /proc/<pid>/root/<target>, all reachable without
     entering the mount ns. Pseudo-filesystems are skipped; mounts created
-    AFTER attach are the remaining (small) gap vs kprobes."""
+    AFTER attach are covered live by the source's remark loop (it polls
+    /proc/<pid>/mountinfo and adds marks on change — opensnoop.bpf.c
+    full-coverage semantics)."""
 
     attach_ns = "mnt"
 
     def _ns_source_args(self, pid: int):
         return (B.SRC_FANOTIFY_OPEN,
                 B.make_cfg(paths=fanotify_mount_paths(pid),
-                           modify=1), 0)
+                           modify=1, remark_pid=pid), 0)
 
 # EventKind values (native/events.h)
 EV_OPEN, EV_BIND, EV_SIGNAL, EV_MOUNT, EV_OOMKILL = 3, 8, 9, 10, 11

