@@ -64,6 +64,20 @@ class TraceDns(_NetnsAttachMixin, SourceTraceGadget):
     def decode_row(self, batch, i):
         c = batch.cols
         aux2 = int(c["aux2"][i])
+        if self._is_native:
+            # native packing (packet.cc parse_dns): aux2 = flags<<32,
+            # flags = 16-bit qtype<<16 | QR bit (0x80) | rcode nibble
+            f = (aux2 >> 32) & 0xFFFFFFFF
+            is_response = bool(f & 0x80)
+            qt = (f >> 16) & 0xFFFF
+            return DnsEvent(
+                timestamp=int(c["ts"][i]), netnsid=int(c["mntns"][i]),
+                pid=int(c["pid"][i]), comm=batch.comm_str(i),
+                qr="R" if is_response else "Q",
+                qtype=_QTYPES.get(qt or 1, f"TYPE{qt}"),
+                name=self.resolve_key(int(c["key_hash"][i])),
+                rcode=_RCODES.get(f & 0xF, "") if is_response else "",
+            )
         return DnsEvent(
             timestamp=int(c["ts"][i]), netnsid=int(c["mntns"][i]),
             pid=int(c["pid"][i]), comm=batch.comm_str(i),

@@ -147,11 +147,19 @@ class PacketSniffSource : public Source {
 
  protected:
   void run() override {
-    // rawsock analogue: enter the target netns before opening the socket
+    // rawsock analogue: enter the target netns before opening the socket.
+    // ETH_P_ALL (not ETH_P_IP) so the IPv6 plane is visible too; the
+    // version-nibble dispatch drops non-IP frames (beats the reference:
+    // dns.c:18 is v4-only)
     if (netns_fd_ >= 0) setns(netns_fd_, CLONE_NEWNET);
     int sock = socket(AF_PACKET, SOCK_DGRAM | SOCK_NONBLOCK,
-                      htons(ETH_P_IP));
+                      htons(ETH_P_ALL));
     if (sock < 0) return;
+    // loopback delivers every local packet twice under ETH_P_ALL (the
+    // OUTGOING copy + the rx); dropping the OUTGOING copy on lo alone
+    // keeps single delivery there while still seeing container-originated
+    // traffic leaving on real interfaces
+    const unsigned int lo_ifindex = if_nametoindex("lo");
     uint64_t last_refresh = 0;
     unsigned char buf[2048];
     while (running_.load(std::memory_order_relaxed)) {
@@ -160,11 +168,17 @@ class PacketSniffSource : public Source {
         enricher_.refresh();
         last_refresh = now;
       }
-      ssize_t len = recv(sock, buf, sizeof(buf), 0);
+      struct sockaddr_ll sll{};
+      socklen_t slen = sizeof(sll);
+      ssize_t len = recvfrom(sock, buf, sizeof(buf), 0,
+                             (struct sockaddr*)&sll, &slen);
       if (len <= 0) {
         std::this_thread::sleep_for(std::chrono::milliseconds(2));
         continue;
       }
+      if (sll.sll_pkttype == PACKET_OUTGOING &&
+          (unsigned int)sll.sll_ifindex == lo_ifindex)
+        continue;
       parse_ip(buf, (size_t)len);
     }
     close(sock);
@@ -173,7 +187,7 @@ class PacketSniffSource : public Source {
  private:
   void emit(uint64_t key_hash, const char* name, size_t name_len,
             uint32_t saddr, uint32_t daddr, uint16_t sport, uint16_t dport,
-            uint32_t kind, uint16_t flags) {
+            uint32_t kind, uint32_t flags) {
     Event ev{};
     ev.ts_ns = now_ns();
     ev.kind = kind;
@@ -196,25 +210,84 @@ class PacketSniffSource : public Source {
   }
 
   void parse_ip(const unsigned char* p, size_t len) {
-    if (len < 20 || (p[0] >> 4) != 4) return;
+    uint8_t ver = len ? (p[0] >> 4) : 0;
+    if (ver == 6) {
+      parse_ip6(p, len);
+      return;
+    }
+    if (len < 20 || ver != 4) return;
     size_t ihl = (size_t)(p[0] & 0xF) * 4;
     if (len < ihl + 8) return;
     uint8_t proto = p[9];
     uint32_t saddr = ntohl(*(const uint32_t*)(p + 12));
     uint32_t daddr = ntohl(*(const uint32_t*)(p + 16));
-    const unsigned char* l4 = p + ihl;
-    size_t l4len = len - ihl;
+    dispatch_l4(proto, p + ihl, len - ihl, saddr, daddr, p + 12, p + 16, 4);
+  }
+
+  // IPv6: fixed 40-byte header + a bounded extension-header walk; the
+  // 128-bit addresses are xor-folded into the 32-bit aux fields (display
+  // names carry the full address via the vocab).
+  void parse_ip6(const unsigned char* p, size_t len) {
+    if (len < 40) return;
+    uint8_t next = p[6];
+    size_t off = 40;
+    for (int hops = 0; hops < 4; hops++) {
+      if (next == 0 || next == 43 || next == 60) {  // hbh/routing/dstopts
+        if (off + 8 > len) return;
+        uint8_t nn = p[off];
+        off += ((size_t)p[off + 1] + 1) * 8;
+        next = nn;
+      } else if (next == 44) {  // fragment (fixed 8 bytes)
+        if (off + 8 > len) return;
+        if (p[off + 2] || (p[off + 3] & 0xF8)) return;  // non-first frag
+        next = p[off];
+        off += 8;
+      } else {
+        break;
+      }
+    }
+    if (off + 8 > len) return;
+    auto fold = [](const unsigned char* a) {
+      uint32_t w = 0;
+      for (int i = 0; i < 4; i++) w ^= ntohl(*(const uint32_t*)(a + 4 * i));
+      return w;
+    };
+    dispatch_l4(next, p + off, len - off, fold(p + 8), fold(p + 24), p + 8,
+                p + 24, 16);
+  }
+
+  // Family-independent L4 dispatch: addr16/alen key the flow dedup (full
+  // 128-bit tuples for v6); display names are formatted lazily, only for
+  // NEW flows (never on the per-packet hot path).
+  void dispatch_l4(uint8_t proto, const unsigned char* l4, size_t l4len,
+                   uint32_t saddr, uint32_t daddr,
+                   const unsigned char* saddr_raw,
+                   const unsigned char* daddr_raw, size_t alen) {
+    if (l4len < 8) return;
     uint16_t sport = ((uint16_t)l4[0] << 8) | l4[1];
     uint16_t dport = ((uint16_t)l4[2] << 8) | l4[3];
     if (filter_ == PKT_FLOW) {
-      uint64_t tuple[3] = {((uint64_t)saddr << 32) | daddr,
-                           ((uint64_t)sport << 16) | dport, proto};
-      uint64_t h = fnv1a64((const char*)tuple, sizeof(tuple));
+      unsigned char tuple[16 * 2 + 5];
+      memcpy(tuple, saddr_raw, alen);
+      memcpy(tuple + alen, daddr_raw, alen);
+      tuple[2 * alen] = (unsigned char)(sport >> 8);
+      tuple[2 * alen + 1] = (unsigned char)sport;
+      tuple[2 * alen + 2] = (unsigned char)(dport >> 8);
+      tuple[2 * alen + 3] = (unsigned char)dport;
+      tuple[2 * alen + 4] = proto;
+      uint64_t h = fnv1a64((const char*)tuple, 2 * alen + 5);
       if (seen_flows_.insert(h).second) {
-        char name[64];
-        int n = snprintf(name, sizeof(name), "%u.%u.%u.%u:%u",
-                         daddr >> 24, (daddr >> 16) & 0xFF,
-                         (daddr >> 8) & 0xFF, daddr & 0xFF, dport);
+        char name[96];
+        int n;
+        if (alen == 16) {
+          char dst[INET6_ADDRSTRLEN] = {0};
+          inet_ntop(AF_INET6, daddr_raw, dst, sizeof(dst));
+          n = snprintf(name, sizeof(name), "[%s]:%u", dst, dport);
+        } else {
+          n = snprintf(name, sizeof(name), "%u.%u.%u.%u:%u", daddr >> 24,
+                       (daddr >> 16) & 0xFF, (daddr >> 8) & 0xFF,
+                       daddr & 0xFF, dport);
+        }
         emit(h, name, (size_t)n, saddr, daddr, sport, dport, EV_NET_GRAPH,
              proto);
       }
@@ -223,7 +296,7 @@ class PacketSniffSource : public Source {
     if (filter_ == PKT_DNS && proto == 17 && l4len > 8 + 12 &&
         (dport == 53 || sport == 53)) {
       parse_dns(l4 + 8, l4len - 8, saddr, daddr, sport, dport);
-    } else if (filter_ == PKT_SNI && proto == 6) {
+    } else if (filter_ == PKT_SNI && proto == 6 && l4len >= 20) {
       size_t doff = (size_t)(l4[12] >> 4) * 4;
       if (l4len > doff) parse_sni(l4 + doff, l4len - doff, saddr, daddr,
                                   sport, dport);
@@ -250,9 +323,11 @@ class PacketSniffSource : public Source {
     if (ni == 0) return;
     uint16_t qtype = (i + 4 < len) ? (((uint16_t)d[i + 1] << 8) | d[i + 2]) : 1;
     uint64_t h = fnv1a64(name, ni);
-    // flags carries QR/rcode; qtype in the upper half of flags word
+    // flags word (32-bit): full 16-bit qtype<<16 | QR bit (0x80) | rcode
+    // nibble (decoded by network_family.py's native branch)
     emit(h, name, ni, saddr, daddr, sport, dport, EV_DNS,
-         (uint16_t)((qtype << 8) | (flags >> 8)));
+         ((uint32_t)qtype << 16) | (uint32_t)(flags >> 8 & 0x80) |
+             (uint32_t)(flags & 0x0F));
   }
 
   // TLS ClientHello SNI walk (ref contract: snisnoop.c)

@@ -164,21 +164,33 @@ class FanotifyOpenSource : public Source {
   ~FanotifyOpenSource() override { stop(); }
 
  protected:
-  // Add marks for mounts that appeared in the watched pid's mount ns
-  // since the last scan. Returns false when the target is gone.
-  bool remark(int fan, uint64_t mask, int mi_fd, const std::string& root,
-              std::unordered_set<std::string>& marked) {
+  // Re-mark every markable mount in the watched pid's mount ns. Marks
+  // are re-added idempotently each pass (FAN_MARK_ADD on a marked mount
+  // merges masks, no duplicate events): a mount REPLACED at the same
+  // target between polls gets a fresh mark instead of being skipped, and
+  // dead mounts stop counting against the budget (their marks die with
+  // the mount). Returns false when the target pid is gone.
+  bool remark(int fan, uint64_t mask, int mi_fd, const std::string& root) {
     std::vector<MountInfoEnt> ents;
     if (!read_mountinfo(mi_fd, ents)) return false;  // pid exited
+    size_t live = 0;
     for (const auto& e : ents) {
-      if (marked.size() >= kMaxMarks) break;
       if (e.target.empty() || e.target == "/") continue;
       if (fanotify_skip_fstype(e.fstype)) continue;
+      if (live >= kMaxMarks) {
+        if (!marks_capped_) {
+          marks_capped_ = true;
+          fprintf(stderr,
+                  "ig: fanotify remark budget (%zu) exceeded for pid %d — "
+                  "later mounts are NOT watched\n",
+                  kMaxMarks, remark_pid_);
+        }
+        break;
+      }
       std::string full = root + e.target;
-      if (marked.count(full)) continue;
       if (fanotify_mark(fan, FAN_MARK_ADD | FAN_MARK_MOUNT, mask, AT_FDCWD,
                         full.c_str()) == 0)
-        marked.insert(full);
+        live++;
     }
     return true;
   }
@@ -213,7 +225,7 @@ class FanotifyOpenSource : public Source {
       // initial sweep: the poll baseline is set at open(), so a mount
       // created between the Python attach-time snapshot and this open
       // would otherwise never fire POLLPRI and never get marked
-      if (mi_fd >= 0 && !remark(fan, mask, mi_fd, root, marked)) {
+      if (mi_fd >= 0 && !remark(fan, mask, mi_fd, root)) {
         close(mi_fd);
         mi_fd = -1;
       }
@@ -226,7 +238,7 @@ class FanotifyOpenSource : public Source {
       nfds_t nf = mi_fd >= 0 ? 2 : 1;
       if (poll(pfds, nf, 100) <= 0) continue;
       if (nf == 2 && (pfds[1].revents & (POLLERR | POLLPRI))) {
-        if (!remark(fan, mask, mi_fd, root, marked)) {
+        if (!remark(fan, mask, mi_fd, root)) {
           close(mi_fd);
           mi_fd = -1;  // target gone; keep serving existing marks
         }
@@ -273,6 +285,7 @@ class FanotifyOpenSource : public Source {
   std::vector<std::string> paths_;
   bool include_modify_ = true;
   int remark_pid_ = 0;
+  bool marks_capped_ = false;
 };
 
 // ---------------------------------------------------------------------------

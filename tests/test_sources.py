@@ -192,6 +192,86 @@ def test_packet_sniffer_flow_edges():
     assert {9901, 9902, 9903} <= edges
 
 
+def _has_ipv6_loopback() -> bool:
+    import socket as pysock
+    try:
+        s = pysock.socket(pysock.AF_INET6, pysock.SOCK_DGRAM)
+        s.bind(("::1", 0))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+@needs_native
+def test_packet_sniffer_captures_dns_query_ipv6():
+    """The v6 plane (beats the reference: dns.c:18 is v4-only): a crafted
+    DNS query over ::1 must reach the same qname walker."""
+    import socket as pysock
+    from inspektor_gadget_tpu.sources.bridge import SRC_PKT_DNS
+
+    if not _has_ipv6_loopback():
+        pytest.skip("no IPv6 loopback")
+    src = NativeCapture(SRC_PKT_DNS, ring_pow2=12)
+    src.start()
+    time.sleep(0.4)
+    qname = b"\x03tpu\x02v6\x07example\x03com\x00"
+    pkt = (b"\x56\x78\x01\x00\x00\x01\x00\x00\x00\x00\x00\x00"
+           + qname + b"\x00\x1c\x00\x01")  # qtype AAAA
+    s = pysock.socket(pysock.AF_INET6, pysock.SOCK_DGRAM)
+    for _ in range(5):
+        s.sendto(pkt, ("::1", 53))
+        time.sleep(0.05)
+    s.close()
+    deadline = time.time() + 3.0
+    found = False
+    while time.time() < deadline and not found:
+        b = src.pop()
+        for i in range(b.count):
+            if b.cols["kind"][i] == 7:  # EV_DNS
+                name = src.vocab_lookup(int(b.cols["key_hash"][i]))
+                if name == "tpu.v6.example.com":
+                    # aux2 = parse_dns flags<<32; flags = qtype<<16 | qr | rcode
+                    assert (int(b.cols["aux2"][i]) >> 48) & 0xFFFF == 28  # AAAA
+                    found = True
+                    break
+        time.sleep(0.05)
+    src.stop(); src.close()
+    assert found, "crafted IPv6 DNS query not captured/parsed"
+
+
+@needs_native
+def test_packet_sniffer_flow_edges_ipv6():
+    """v6 flow edges dedupe over the full 128-bit tuple and display
+    [addr]:port names."""
+    import socket as pysock
+    from inspektor_gadget_tpu.sources.bridge import SRC_PKT_FLOW
+
+    if not _has_ipv6_loopback():
+        pytest.skip("no IPv6 loopback")
+    src = NativeCapture(SRC_PKT_FLOW, ring_pow2=12)
+    src.start()
+    time.sleep(0.4)
+    s = pysock.socket(pysock.AF_INET6, pysock.SOCK_DGRAM)
+    for port in (9911, 9912):
+        s.sendto(b"x", ("::1", port))
+    s.close()
+    deadline = time.time() + 3.0
+    names = {}
+    while time.time() < deadline and len(names) < 2:
+        b = src.pop()
+        for i in range(b.count):
+            if b.cols["kind"][i] == 17:  # EV_NET_GRAPH
+                port = int(b.cols["aux2"][i]) & 0xFFFF
+                if port in (9911, 9912):
+                    names[port] = src.vocab_lookup(
+                        int(b.cols["key_hash"][i]))
+        time.sleep(0.05)
+    src.stop(); src.close()
+    assert set(names) == {9911, 9912}, names
+    assert all(n.startswith("[::1]:") for n in names.values()), names
+
+
 @needs_native
 def test_fanotify_watch_real_exec():
     """fanotify exec-watch (runcfanotify analogue): watch /bin/true, exec
