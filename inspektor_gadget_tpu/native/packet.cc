@@ -217,7 +217,7 @@ class PacketSniffSource : public Source {
     }
     if (len < 20 || ver != 4) return;
     size_t ihl = (size_t)(p[0] & 0xF) * 4;
-    if (len < ihl + 8) return;
+    if (ihl < 20 || len < ihl + 8) return;  // corrupt IHL nibble
     uint8_t proto = p[9];
     uint32_t saddr = ntohl(*(const uint32_t*)(p + 12));
     uint32_t daddr = ntohl(*(const uint32_t*)(p + 16));
@@ -246,6 +246,9 @@ class PacketSniffSource : public Source {
         break;
       }
     }
+    // a chain longer than the walk bound leaves an unconsumed extension
+    // header — its bytes must not be parsed as L4 ports
+    if (next == 0 || next == 43 || next == 44 || next == 60) return;
     if (off + 8 > len) return;
     auto fold = [](const unsigned char* a) {
       uint32_t w = 0;

@@ -66,6 +66,9 @@ class KubeManagerInstance(LocalManagerInstance):
             labels=labels,
         )
         self._tracer_id = f"kube-{ctx.run_id}"
+        # the base __init__ marked from the (empty) localmanager params;
+        # re-mark with the real k8s selector
+        self._mark_selector_active()
 
 
 register(KubeManager())

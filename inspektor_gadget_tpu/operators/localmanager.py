@@ -95,20 +95,35 @@ class LocalManagerInstance(OperatorInstance):
         self.host = params.get("host").as_bool() if "host" in params else False
         self._tracer_id = f"{ctx.run_id}"
         self._attached: list[Container] = []
+        self._mark_selector_active()
+
+    def _selector_set(self) -> bool:
+        return bool(self.selector.name or self.selector.pod
+                    or self.selector.namespace
+                    or getattr(self.selector, "labels", None))
+
+    def _mark_selector_active(self) -> None:
+        """Both manager flavours (local + kube) run on every gadget; when
+        ONE of them carries a user selector, the other must not attach-all
+        (its empty selector would capture every container and defeat the
+        scoping — the black-box negative test's leak)."""
+        if self._selector_set():
+            self.ctx.extra["container_selector_active"] = True
 
     def pre_gadget_run(self) -> None:
         op = self.op
         if op.tc is None:
             return
+        if (not self._selector_set()
+                and self.ctx.extra.get("container_selector_active")):
+            return  # the scoped manager instance owns this run
         # ref: localmanager.go:208-228 — register tracer, inject filter
         op.tc.add_tracer(self._tracer_id, self.selector)
         if isinstance(self.gadget, MountNsFilterSetter):
             # filter only when a container selector is active; a bare local
             # run traces everything including host (ref: localmanager.go
             # host/containername param semantics)
-            if (self.selector.name or self.selector.pod
-                    or self.selector.namespace
-                    or getattr(self.selector, "labels", None)):
+            if self._selector_set():
                 self.gadget.set_mntns_filter(
                     op.tc.tracer_mntns_set(self._tracer_id))
         if isinstance(self.gadget, Attacher) and self._attach_enabled():
@@ -151,9 +166,7 @@ class LocalManagerInstance(OperatorInstance):
             return False
         if not getattr(self.gadget, "attach_requires_selector", False):
             return True
-        return bool(self.selector.name or self.selector.pod
-                    or self.selector.namespace
-                    or getattr(self.selector, "labels", None))
+        return self._selector_set()
 
     def _on_container_event(self, ev) -> None:
         if not self.selector.matches(ev.container):
