@@ -80,6 +80,106 @@ def _free_port() -> int:
     return port
 
 
+ELASTIC_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    sys.path.insert(0, os.getcwd())
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    coord_a, coord_b, pid, tmpdir = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), sys.argv[4])
+    from inspektor_gadget_tpu.parallel.distributed import (
+        init_distributed, make_multihost_mesh, world_size,
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from inspektor_gadget_tpu.ops import bundle_init, bundle_update
+    from inspektor_gadget_tpu.parallel.cluster import cluster_merge
+    from inspektor_gadget_tpu.parallel.mesh import NODE_AXIS
+
+    SHAPE = dict(depth=4, log2_width=10, hll_p=8, entropy_log2_width=7,
+                 k=16)
+    PER_PROC = 512
+
+    def local_keys_np(seed, n=PER_PROC):
+        rng = np.random.default_rng(seed)
+        return rng.integers(1, 2**31, n, dtype=np.int64).astype(np.uint32)
+
+    def merge_world(n_procs, bundle):
+        '''Stack [bundle, empty] per process (empty is merge-neutral) and
+        psum over the node axis; returns (merged_events, p50_ms).'''
+        mesh = make_multihost_mesh()
+        assert mesh.shape[NODE_AXIS] == 2 * n_procs, mesh.shape
+        empty = bundle_init(**SHAPE)
+        stacked = jax.tree.map(lambda a, b: np.stack([np.asarray(a),
+                                                      np.asarray(b)]),
+                               bundle, empty)
+        sharding = NamedSharding(mesh, P(NODE_AXIS))
+        garr = jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            stacked)
+        step = jax.jit(jax.shard_map(
+            cluster_merge, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P(),
+            check_vma=False))
+        merged = step(garr)
+        jax.block_until_ready(merged.events)
+        ticks = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(garr).events)
+            ticks.append((time.perf_counter() - t0) * 1000.0)
+        local_m = jax.tree.map(lambda a: a.addressable_shards[0].data, merged)
+        return float(local_m.events), float(np.percentile(ticks, 50))
+
+    # the world must exist BEFORE any jax computation (backends snapshot
+    # the distributed config at creation)
+    init_distributed(coord_a, num_processes=4, process_id=pid)
+    assert world_size() == 4
+
+    # per-PROCESS local state, retained across world re-formation — the
+    # role of pinned maps surviving restarts, at the collective tier
+    local = bundle_init(**SHAPE)
+    k = jnp.asarray(local_keys_np(100 + pid))
+    local = bundle_update(local, k, k, k, jnp.ones(k.shape, bool))
+
+    events1, p50_1 = merge_world(4, local)
+    print(json.dumps({"phase": 1, "pid": pid, "merged_events": events1,
+                      "merge_p50_ms": p50_1}), flush=True)
+
+    # host-offload, tear the world down, forget its backend (survivor
+    # restart semantics: state lives on the host between worlds)
+    local_np = jax.tree.map(np.asarray, local)
+    jax.distributed.shutdown()
+    import jax.extend.backend as jeb
+    jeb.clear_backends()
+
+    # keep ingesting (host-side) while waiting; the kill lands here
+    go2 = os.path.join(tmpdir, "phase2_go")
+    extra_batches = []
+    while not os.path.exists(go2):
+        if len(extra_batches) < 20:
+            extra_batches.append(
+                local_keys_np(1000 + pid * 31 + len(extra_batches), 64))
+        time.sleep(0.05)
+
+    # survivors re-form a 3-process world and merge their retained state
+    init_distributed(coord_b, num_processes=3, process_id=pid)
+    local = jax.tree.map(jnp.asarray, local_np)
+    for kb in extra_batches:
+        k = jnp.asarray(kb)
+        local = bundle_update(local, k, k, k, jnp.ones(k.shape, bool))
+    assert world_size() == 3
+    events2, p50_2 = merge_world(3, local)
+    print(json.dumps({"phase": 2, "pid": pid,
+                      "local_events": float(local.events),
+                      "merged_events": events2,
+                      "merge_p50_ms": p50_2}), flush=True)
+""")
+
+
 def test_two_process_sketch_merge(tmp_path):
     coord = f"127.0.0.1:{_free_port()}"
     script = tmp_path / "worker.py"
@@ -101,3 +201,109 @@ def test_two_process_sketch_merge(tmp_path):
     for o in outs:
         assert o["events"] == 4 * 512, o
         assert abs(o["est"] - o["true"]) / o["true"] < 0.1, o
+
+
+def test_four_process_kill_one_and_remerge(tmp_path):
+    """The deepened tier (VERDICT r4 item 8): a 4-process world merges and
+    reports cross-process merge timing; one worker is SIGKILLed mid-ingest;
+    the surviving three re-form a smaller world and their merge preserves
+    every survivor's retained counts (node-failure semantics at the
+    collective tier — per-node error isolation, runtime.go:42-79, where
+    the 'partial result' is the survivors' union)."""
+    import json as _json
+    import os
+    import signal
+    import time
+
+    coord_a = f"127.0.0.1:{_free_port()}"
+    coord_b = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(ELASTIC_WORKER)
+    # stderr goes to files: an undrained stderr PIPE deadlocks a chatty
+    # worker at the ~64KB pipe buffer
+    err_files = [open(tmp_path / f"worker{i}.err", "w+") for i in range(4)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coord_a, coord_b, str(i),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=err_files[i], text=True,
+            cwd="/root/repo")
+        for i in range(4)
+    ]
+
+    def worker_stderr(i: int) -> str:
+        err_files[i].flush()
+        err_files[i].seek(0)
+        return err_files[i].read()[-3000:]
+
+    def check_alive(expected: set):
+        for i in expected:
+            if procs[i].poll() not in (None, 0):
+                raise AssertionError(
+                    f"worker {i} died early: {worker_stderr(i)}")
+
+    try:
+        # wait for phase 1 from every worker (read incrementally so the
+        # pipes don't fill)
+        phase1 = {}
+        deadline = time.time() + 360
+        import selectors
+        sel = selectors.DefaultSelector()
+        for i, p in enumerate(procs):
+            os.set_blocking(p.stdout.fileno(), False)
+            sel.register(p.stdout, selectors.EVENT_READ, i)
+        while len(phase1) < 4 and time.time() < deadline:
+            for key, _ in sel.select(timeout=1.0):
+                chunk = key.fileobj.readline()
+                while chunk:
+                    if chunk.startswith("{"):
+                        rec = _json.loads(chunk)
+                        if rec.get("phase") == 1:
+                            phase1[key.data] = rec
+                    chunk = key.fileobj.readline()
+            check_alive({0, 1, 2, 3})
+        assert len(phase1) == 4, f"phase1 incomplete: {phase1}"
+        # 4 procs x 512 keys each, merged across the world
+        for rec in phase1.values():
+            assert rec["merged_events"] == 4 * 512, rec
+        p50_4proc = phase1[0]["merge_p50_ms"]
+
+        # SIGKILL worker 3 mid-ingest, then release the survivors; its
+        # EOF'd pipe must leave the selector or select() busy-spins
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+        sel.unregister(procs[3].stdout)
+        (tmp_path / "phase2_go").write_text("go")
+
+        phase2 = {}
+        deadline = time.time() + 360
+        while len(phase2) < 3 and time.time() < deadline:
+            for key, _ in sel.select(timeout=1.0):
+                chunk = key.fileobj.readline()
+                while chunk:
+                    if chunk.startswith("{"):
+                        rec = _json.loads(chunk)
+                        if rec.get("phase") == 2:
+                            phase2[key.data] = rec
+                    chunk = key.fileobj.readline()
+            check_alive({0, 1, 2})
+        assert len(phase2) == 3, f"phase2 incomplete: {phase2}"
+        survivors_local = sum(r["local_events"] for r in phase2.values())
+        for rec in phase2.values():
+            # the re-formed merge carries EVERY survivor's retained counts
+            assert rec["merged_events"] == survivors_local, (
+                rec, survivors_local)
+            assert rec["local_events"] >= 512  # pre-kill state not lost
+        print(f"cross-process merge p50: 4-proc {p50_4proc:.2f} ms, "
+              f"3-proc {phase2[0]['merge_p50_ms']:.2f} ms")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        for f in err_files:
+            f.close()
