@@ -154,13 +154,22 @@ class TraceNetwork(_NetnsAttachMixin, SourceTraceGadget):
     native_kind = getattr(B, "SRC_PKT_FLOW", None)
     synth_kind = B.SRC_SYNTH_TCP
 
+    _PROTOS = {6: "tcp", 17: "udp", 1: "icmp", 58: "icmp6", 132: "sctp"}
+
     def decode_row(self, batch, i):
         c = batch.cols
         aux1, aux2 = int(c["aux1"][i]), int(c["aux2"][i])
+        if self._is_native:
+            # native packing (packet.cc dispatch_l4 flow branch):
+            # aux2 = ip_proto<<32 | sport<<16 | dport
+            proto_nr = (aux2 >> 32) & 0xFF
+            proto = self._PROTOS.get(proto_nr, str(proto_nr))
+        else:
+            proto = "tcp" if aux2 % 2 == 0 else "udp"  # synthetic stand-in
         return NetworkEvent(
             timestamp=int(c["ts"][i]), netnsid=int(c["mntns"][i]),
             pid=int(c["pid"][i]), comm=batch.comm_str(i),
-            proto="tcp" if aux2 % 2 == 0 else "udp",
+            proto=proto,
             port=aux2 & 0xFFFF,
             remote=self.resolve_key(int(c["key_hash"][i])) or f"{aux1 & 0xFF}.x",
         )

@@ -48,3 +48,48 @@ def test_cli_deploy_render():
     assert r.returncode == 0
     assert "kind: DaemonSet" in r.stdout
     assert "google.com/tpu" in r.stdout
+
+
+def test_cli_traces_lifecycle_against_live_daemon(tmp_path):
+    """The kubectl-gadget advise ergonomics (§3.5) as a black box: a real
+    agent daemon subprocess + `ig-tpu traces` verbs from separate CLI
+    processes (ref: cmd/kubectl-gadget/utils/trace.go:340-848)."""
+    import os
+    import time
+
+    addr = f"unix://{tmp_path}/agent.sock"
+    remote = f"n0={addr}"
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "inspektor_gadget_tpu.agent.main", "serve",
+         "--listen", addr, "--node-name", "n0", "--no-doctor"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        cwd="/root/repo")
+    try:
+        deadline = time.time() + 120
+        up = False
+        while time.time() < deadline and not up:
+            if os.path.exists(f"{tmp_path}/agent.sock"):
+                r = run_cli("traces", "list", "--remote", remote)
+                up = r.returncode == 0
+            if not up:
+                time.sleep(1.0)
+        assert up, "agent never served"
+
+        r = run_cli("traces", "start", "--remote", remote, "--name", "bb1",
+                    "--gadget", "advise/seccomp-profile",
+                    "-p", "source=pysynthetic", "-p", "rate=20000")
+        assert r.returncode == 0, r.stderr
+        assert "bb1 Started" in r.stdout
+        time.sleep(1.0)
+        r = run_cli("traces", "generate", "--remote", remote,
+                    "--name", "bb1")
+        assert r.returncode == 0, r.stderr
+        assert "defaultAction" in r.stdout
+        r = run_cli("traces", "delete", "--remote", remote, "--name", "bb1")
+        assert r.returncode == 0 and "deleted=True" in r.stdout
+    finally:
+        daemon.terminate()
+        try:
+            daemon.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            daemon.kill()

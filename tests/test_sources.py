@@ -273,6 +273,48 @@ def test_packet_sniffer_flow_edges_ipv6():
 
 
 @needs_native
+def test_trace_network_decodes_real_protocol():
+    """trace/network's native decode must read the IP protocol from the
+    wire (aux2>>32), not infer it — a UDP flow to an even port and a TCP
+    flow to an odd port would both misdecode under port-parity."""
+    import socket as pysock
+    import threading
+
+    import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+    from inspektor_gadget_tpu.gadgets import GadgetContext, get
+
+    desc = get("trace", "network")
+    params = desc.params().to_params()
+    params.set("source", "native")
+    ctx = GadgetContext(desc, gadget_params=params, timeout=3.0)
+    g = desc.new_instance(ctx)
+    events = []
+    g.set_event_handler(events.append)
+
+    def traffic():
+        time.sleep(0.8)
+        s = pysock.socket(pysock.AF_INET, pysock.SOCK_DGRAM)
+        s.sendto(b"x", ("127.0.0.1", 9942))  # UDP to an EVEN port
+        s.close()
+        t = pysock.socket()
+        t.settimeout(0.5)
+        try:
+            t.connect(("127.0.0.1", 9943))   # TCP to an ODD port
+        except OSError:
+            pass
+        t.close()
+
+    threading.Thread(target=traffic, daemon=True).start()
+    threading.Thread(target=ctx.wait_for_timeout_or_done,
+                     daemon=True).start()
+    g.run(ctx)
+    by_port = {e.port: e.proto for e in events
+               if e is not None and e.port in (9942, 9943)}
+    assert by_port.get(9942) == "udp", by_port
+    assert by_port.get(9943) == "tcp", by_port
+
+
+@needs_native
 def test_fanotify_watch_real_exec():
     """fanotify exec-watch (runcfanotify analogue): watch /bin/true, exec
     it, assert the watcher reports the exec with pid identity."""
