@@ -308,7 +308,14 @@ class AgentServer:
         except Exception as e:
             dump_error = f"container dump failed: {e!r}"
         msg = {"threads": frames, "active_runs": runs,
-               "containers": containers}
+               "containers": containers,
+               # CRD-path state rides the same debug dump (the reference's
+               # daemon dumps its trace list alongside containers)
+               "traces": [{"name": t["metadata"]["name"],
+                           "gadget": t["spec"].get("gadget", ""),
+                           "state": t["status"].get("state", ""),
+                           "error": t["status"].get("operationError", "")}
+                          for t in self.traces.list()]}
         if dump_error:
             msg["error"] = dump_error
         return wire.encode_msg(msg)

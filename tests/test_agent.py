@@ -194,8 +194,14 @@ def test_container_hook_rpc(agents):
 
 def test_dump_state_debug_rpc(agents):
     client = AgentClient(agents["node-0"], "node-0")
+    client.apply_trace({"metadata": {"name": "dump-t",
+                                     "annotations": {}},
+                        "spec": {"gadget": "trace/exec"}})
     state = client.dump_state()
     assert "threads" in state and state["threads"]
+    # CRD-path state rides the same dump
+    assert any(t["name"] == "dump-t" for t in state["traces"])
+    client.delete_trace("dump-t")
     client.close()
 
 
