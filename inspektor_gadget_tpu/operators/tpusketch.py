@@ -187,7 +187,15 @@ class TpuSketchInstance(OperatorInstance):
         self._names: dict[int, str] = {}
         self.on_summary: Callable[[SketchSummary], None] | None = ctx.extra.get(
             "on_sketch_summary")
-        self._pad = 8192  # fixed device batch shape (pad/mask)
+        # fixed device batch shape (pad/mask): start at the gadget's own
+        # batch size so the first batches don't compile a ladder of
+        # intermediate pad shapes (each is a fresh ~15s TPU compile)
+        pad = 8192
+        if "batch-size" in ctx.gadget_params:
+            bs = ctx.gadget_params.get("batch-size").as_int()
+            if bs > 0:
+                pad = max(pad, 1 << (bs - 1).bit_length())
+        self._pad = pad
         # self-observability feed for top/sketch (top/ebpf analogue)
         from ..gadgets.top.sketch import SketchStatsSource
         self._stats = SketchStatsSource(ctx.run_id, ctx.desc.full_name)
