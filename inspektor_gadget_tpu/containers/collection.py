@@ -13,7 +13,7 @@ import dataclasses
 import enum
 import threading
 import time
-from typing import Callable, Iterable
+from typing import Callable
 
 from .container import Container, ContainerSelector
 

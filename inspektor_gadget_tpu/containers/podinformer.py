@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 from typing import Callable, Iterable
 
 from .container import Container

@@ -11,7 +11,6 @@ stream; YAML rendered without external deps.
 from __future__ import annotations
 
 import dataclasses
-import json
 from collections import defaultdict
 
 from ...params import ParamDescs

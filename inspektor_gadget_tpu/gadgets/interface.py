@@ -17,7 +17,7 @@ consumes rows.
 from __future__ import annotations
 
 import enum
-from typing import Any, Callable, Iterable, Protocol, runtime_checkable
+from typing import Any, Callable, Protocol, runtime_checkable
 
 from ..columns import Columns
 from ..params import ParamDescs

@@ -14,7 +14,6 @@ Two windows, per-IO preferred:
 
 from __future__ import annotations
 
-import time
 
 from ...params import ParamDesc, ParamDescs, TypeHint
 from ...sources.bridge import (

@@ -10,7 +10,6 @@ supply the event dataclass + a row decoder + source kind.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable
 
 import numpy as np

@@ -26,7 +26,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-from typing import Any, Callable
+from typing import Any
 
 from ..params import Params
 from .context import GadgetContext

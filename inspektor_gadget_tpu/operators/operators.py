@@ -14,7 +14,7 @@ columnar hot path; the per-event enrich() remains for the formatter path.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Iterable
+from typing import Any, Iterable
 
 from ..gadgets.context import GadgetContext
 from ..gadgets.interface import GadgetDesc

@@ -28,10 +28,9 @@ import jax.numpy as jnp
 
 from ..columns import col
 from ..gadgets.context import GadgetContext
-from ..gadgets.interface import BatchHandlerSetter, GadgetDesc
+from ..gadgets.interface import GadgetDesc
 from ..models.autoencoder import AEConfig, ae_init, ae_score, ae_train_step, normalize_counts
 from ..ops import bundle_init, fold64_to_32, hll_estimate, entropy_estimate
-from ..ops.countmin import cms_query
 from ..ops.sketches import bundle_update_jit
 from ..params import ParamDesc, ParamDescs, Params, TypeHint
 from ..sources.batch import EventBatch

@@ -19,7 +19,7 @@ from typing import Any, Callable
 
 from ..gadgets.context import GadgetContext
 from ..gadgets.interface import GadgetType
-from ..params import ParamDesc, ParamDescs, Params
+from ..params import ParamDesc, ParamDescs
 from ..snapshotcombiner import SnapshotCombiner
 from .runtime import CombinedGadgetResult, GadgetResult, Runtime
 

@@ -14,7 +14,7 @@ parallel/cluster.py); this class covers the exact-row path.
 from __future__ import annotations
 
 import threading
-from typing import Any, Generic, TypeVar
+from typing import Generic, TypeVar
 
 T = TypeVar("T")
 
