@@ -30,8 +30,8 @@ from ..columns import col
 from ..gadgets.context import GadgetContext
 from ..gadgets.interface import GadgetDesc
 from ..models.autoencoder import AEConfig, ae_init, ae_score, ae_train_step, normalize_counts
-from ..ops import bundle_init, fold64_to_32, hll_estimate, entropy_estimate
-from ..ops.sketches import bundle_update_jit
+from ..ops import bundle_init, fold64_to_32
+from ..ops.sketches import bundle_digest_jit, bundle_update_jit, decode_digest
 from ..params import ParamDesc, ParamDescs, Params, TypeHint
 from ..sources.batch import EventBatch
 from .operators import Operator, OperatorInstance, register
@@ -307,9 +307,10 @@ class TpuSketchInstance(OperatorInstance):
     # harvest ---------------------------------------------------------------
 
     def harvest(self) -> SketchSummary:
-        b = self.bundle
-        keys = np.asarray(b.topk.keys)
-        counts = np.asarray(b.topk.counts)
+        # one packed digest: a single D2H transfer per tick, not 6 (each
+        # read through the tunnel is tens of ms)
+        events_f, drops_f, distinct, entropy_bits, keys, counts = (
+            decode_digest(bundle_digest_jit(self.bundle)))
         order = np.argsort(-counts)
         hh = [(int(keys[i]), int(counts[i])) for i in order if keys[i] != 0]
         anomaly = None
@@ -329,10 +330,10 @@ class TpuSketchInstance(OperatorInstance):
                        zip(self._container_counts.keys(), scores)}
         self._epoch += 1
         summary = SketchSummary(
-            events=int(float(b.events)),
-            drops=int(float(b.drops)),
-            distinct=float(hll_estimate(b.hll)),
-            entropy_bits=float(entropy_estimate(b.entropy)),
+            events=int(events_f),
+            drops=int(drops_f),
+            distinct=distinct,
+            entropy_bits=entropy_bits,
             heavy_hitters=hh,
             anomaly=anomaly,
             epoch=self._epoch,
