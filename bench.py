@@ -90,6 +90,9 @@ def _make_gen(batch: int):
 
 def host_plane_ev_per_s(batch: int = 1 << 17, seconds: float = 1.0) -> float:
     """Generator+fold throughput with no JAX: the capture-path ceiling."""
+    from inspektor_gadget_tpu.telemetry import counter
+    events = counter("ig_bench_host_events_total",
+                     "events generated+folded by the host plane")
     gen = _make_gen(batch)
     gen()  # warm (vocab tables, allocator)
     n = 0
@@ -97,6 +100,7 @@ def host_plane_ev_per_s(batch: int = 1 << 17, seconds: float = 1.0) -> float:
     while time.perf_counter() - t0 < seconds:
         gen()
         n += batch
+        events.inc(batch)
     return n / (time.perf_counter() - t0)
 
 
@@ -111,8 +115,14 @@ def run_child(platform: str) -> dict:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
+    from inspektor_gadget_tpu import telemetry as T
     from inspektor_gadget_tpu.ops import bundle_merge
     from inspektor_gadget_tpu.ops.sketches import bundle_init, bundle_update_jit
+
+    m_steps = T.counter("ig_bench_e2e_steps_total",
+                        "bundle_update steps in the timed e2e window")
+    m_events = T.counter("ig_bench_e2e_events_total",
+                         "events through the timed e2e window")
 
     cfg = SHAPES[platform]
     batch = cfg["batch"]
@@ -166,6 +176,8 @@ def run_child(platform: str) -> dict:
         k = jnp.asarray(q.get())
         bundle = bundle_update_jit(bundle, k, k, k, mask)
         steps += 1
+        m_steps.inc()
+        m_events.inc(batch)
         if steps % 4 == 0:
             jax.block_until_ready(bundle.events)
     jax.block_until_ready(bundle.events)
@@ -216,6 +228,9 @@ def run_child(platform: str) -> dict:
         "merge_ms_p50": round(float(np.percentile(times, 50) * 1000), 3),
         "platform": actual,
         "batch": batch,
+        # the child's live pipeline counters ride home with its result so
+        # the parent's record carries them (the registry is per-process)
+        "telemetry": T.snapshot(),
     }
 
 
@@ -284,6 +299,19 @@ def main() -> None:
         extra["degraded"] = True
     if errors:
         extra["error"] = errors
+
+    # telemetry snapshot: the platform/degraded facts become registry
+    # gauges and the record carries real pipeline counters (the child's
+    # device-plane counters merged with this process's host-plane ones)
+    # instead of only hand-assembled extras
+    from inspektor_gadget_tpu.telemetry import gauge, snapshot
+    gauge("ig_bench_degraded",
+          "1 when the headline ran on a fallback platform").set(
+        1.0 if extra["degraded"] else 0.0)
+    gauge("ig_bench_platform_info", "platform the headline ran on",
+          ("platform",)).labels(platform=extra["platform"]).set(1.0)
+    child_tel = result.pop("telemetry", {}) if result else {}
+    extra["telemetry"] = {**child_tel, **snapshot()}
 
     print(json.dumps({
         "metric": "sketch_ingest_throughput_e2e",

@@ -158,9 +158,10 @@ def config1d_display_path(seconds: float) -> dict:
                             extra=extra)
         cols = ctx.columns
         cols.hide_tagged(["kubernetes"])
-        filters = parse_filters(filter_spec, cols)
-        extra["display_filters"] = filters
-        extra["display_columns"] = cols
+        filters = parse_filters(filter_spec, cols) if filter_spec else []
+        if filters:
+            extra["display_filters"] = filters
+            extra["display_columns"] = cols
         formatter = TextFormatter(cols)
         out = io.StringIO()
         shown = [0]
@@ -173,6 +174,11 @@ def config1d_display_path(seconds: float) -> dict:
                 return
             shown[0] += 1
             out.write(formatter.format_event(ev) + "\n")
+            if shown[0] % 65536 == 0:
+                # the unfiltered variant formats EVERY row; cap the sink
+                # so a long window doesn't hold gigabytes of rendered text
+                out.seek(0)
+                out.truncate(0)
 
         def on_batch(b):
             ingested[0] += b.count
@@ -188,12 +194,22 @@ def config1d_display_path(seconds: float) -> dict:
 
     rate_comm, shown_comm = run_display("comm:proc-42")
     rate_pid, _ = run_display("pid:>4000000000")
+    # unfiltered variant: every popped row decodes + formats (match rate
+    # 100%) — the honest ceiling of the render path. The ≥5M ev/s claim is
+    # the FILTERED path (filters pushed down columnar, survivors only);
+    # both land in the record so neither masquerades as the other.
+    rate_all, shown_all = run_display("")
     return {"config": "1d", "name": "trace-exec-display-path",
             "metric": "display_ingest_ev_per_s", "unit": "events/sec",
             "value": round(min(rate_comm, rate_pid), 1),
             "extra": {"comm_filter_ev_per_s": round(rate_comm, 1),
                       "numeric_filter_ev_per_s": round(rate_pid, 1),
-                      "rows_shown_comm": shown_comm, "target": 5_000_000}}
+                      "unfiltered_ev_per_s": round(rate_all, 1),
+                      "rows_shown_comm": shown_comm,
+                      "rows_shown_unfiltered": shown_all,
+                      "note": "value/target are the filtered display path; "
+                              "unfiltered_ev_per_s formats every row",
+                      "target": 5_000_000}}
 
 
 # ---------------------------------------------------------------------------

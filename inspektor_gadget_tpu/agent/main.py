@@ -36,6 +36,9 @@ def main(argv=None) -> int:
                     help="persist live sketch state here each interval; "
                          "resumed (merged) after restart")
     sp.add_argument("--checkpoint-interval", type=float, default=30.0)
+    sp.add_argument("--metrics-addr", default="",
+                    help="serve Prometheus text metrics on host:port "
+                         "(e.g. :9100); off by default")
     sp.add_argument("--watch-traces", action="store_true",
                     help="reconcile Trace resources off the kube API "
                          "(requires --kube-api; controller role of "
@@ -157,7 +160,11 @@ def _serve_loop(args) -> int:
     # nobody serves stalls every container creation on the host
     server, _agent = serve(args.listen, node_name=args.node_name,
                            checkpoint_dir=args.checkpoint_dir,
-                           checkpoint_interval=args.checkpoint_interval)
+                           checkpoint_interval=args.checkpoint_interval,
+                           metrics_addr=args.metrics_addr)
+    if _agent.metrics_server is not None:
+        print(f"metrics on http://{_agent.metrics_server.host}:"
+              f"{_agent.metrics_server.port}/metrics", flush=True)
     installer = None
     watcher = None
     try:
@@ -220,6 +227,8 @@ def _serve_loop(args) -> int:
         # non-daemon gRPC workers keeping a dead agent alive
         if watcher is not None:
             watcher.stop()
+        if _agent.metrics_server is not None:
+            _agent.metrics_server.stop()
         _agent.stop_checkpointer()
         if installer is not None:
             installer.uninstall()

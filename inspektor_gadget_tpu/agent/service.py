@@ -32,11 +32,26 @@ from ..operators import operators as op_registry
 from ..params import Collection
 from ..runtime.local import LocalRuntime
 from ..runtime.runtime import build_catalog
+from ..telemetry import counter, gauge
 from . import wire
 
 EVENT_BUFFER = 1024  # ref: service.go:134 bounded buffer, drop-on-full
 
 log = logging.getLogger("ig-tpu.agent")
+
+# per-stream RPC telemetry (one lock touch per message, never per event —
+# a message carries a whole batch/array)
+_tm_rpc = counter("ig_agent_rpc_total", "agent RPCs served", ("method",))
+_tm_stream_msgs = counter("ig_agent_stream_msgs_total",
+                          "messages pushed onto RunGadget streams",
+                          ("gadget",))
+_tm_stream_dropped = counter("ig_agent_stream_dropped_total",
+                             "stream messages dropped on backpressure",
+                             ("gadget",))
+_tm_stream_q = gauge("ig_agent_stream_queue_depth",
+                     "RunGadget out-queue depth at last push (backpressure)",
+                     ("gadget",))
+_tm_active_runs = gauge("ig_agent_active_runs", "gadget runs in flight")
 
 
 class AgentServer:
@@ -50,6 +65,7 @@ class AgentServer:
         from ..gadgets.trace_resource import TraceStore
         self.traces = TraceStore(node_name=node_name)
         self._ckpt_stop: threading.Event | None = None
+        self.metrics_server = None  # set by serve(--metrics-addr)
 
     def start_checkpointer(self, directory: str,
                            interval: float = 30.0) -> None:
@@ -82,6 +98,7 @@ class AgentServer:
     # -- GadgetManager.GetCatalog ------------------------------------------
 
     def get_catalog(self, request: bytes, context) -> bytes:
+        _tm_rpc.labels(method="GetCatalog").inc()
         catalog = build_catalog()
         catalog["node"] = self.node_name
         return wire.encode_msg({"catalog": catalog})
@@ -89,6 +106,7 @@ class AgentServer:
     # -- GadgetManager.RunGadget (bidi stream) ------------------------------
 
     def run_gadget(self, request_iterator: Iterator[bytes], context) -> Iterator[bytes]:
+        _tm_rpc.labels(method="RunGadget").inc()
         first = next(request_iterator)
         header, _ = wire.decode_msg(first)
         run = header.get("run")
@@ -121,18 +139,38 @@ class AgentServer:
         ctx.extra["output"] = "json" if "result-json" in outputs else "columns"
         with self._runs_mu:
             self._runs[ctx.run_id] = ctx
+        _tm_active_runs.inc()
+        try:
+            yield from self._run_gadget_stream(ctx, desc, outputs,
+                                               request_iterator, context)
+        finally:
+            # also reached via GeneratorExit when the client cancels the
+            # stream mid-run: the run must be cancelled and accounting
+            # unwound, or _runs and the active-runs gauge drift upward
+            ctx.cancel()
+            with self._runs_mu:
+                self._runs.pop(ctx.run_id, None)
+            _tm_active_runs.dec()
 
+    def _run_gadget_stream(self, ctx, desc, outputs, request_iterator,
+                           context) -> Iterator[bytes]:
         out_q: queue.Queue = queue.Queue(maxsize=EVENT_BUFFER)
         dropped = [0]
         seq = [0]
+        m_msgs = _tm_stream_msgs.labels(gadget=desc.full_name)
+        m_dropped = _tm_stream_dropped.labels(gadget=desc.full_name)
+        m_qdepth = _tm_stream_q.labels(gadget=desc.full_name)
 
         def push(kind: int, header: dict, payload: bytes = b""):
             seq[0] += 1
             header = {**header, "seq": seq[0], "type": kind}
             try:
                 out_q.put_nowait(wire.encode_msg(header, payload))
+                m_msgs.inc()
+                m_qdepth.set(out_q.qsize())
             except queue.Full:
                 dropped[0] += 1  # ref: service.go:160-167 drop-on-full
+                m_dropped.inc()
 
         cols = desc.columns()
 
@@ -228,12 +266,11 @@ class AgentServer:
         if dropped[0]:
             yield wire.encode_msg({"type": wire.EV_CONTROL_ACK,
                                    "dropped": dropped[0]})
-        with self._runs_mu:
-            self._runs.pop(ctx.run_id, None)
 
     # -- ContainerManager (hook-facing; ref: gadgettracermanager.go:151) ----
 
     def add_container(self, request: bytes, context) -> bytes:
+        _tm_rpc.labels(method="AddContainer").inc()
         h, _ = wire.decode_msg(request)
         from ..operators.operators import ensure_initialized
         lm = ensure_initialized("localmanager")
@@ -247,6 +284,7 @@ class AgentServer:
         return wire.encode_msg({"ok": True, "count": len(lm.cc)})
 
     def remove_container(self, request: bytes, context) -> bytes:
+        _tm_rpc.labels(method="RemoveContainer").inc()
         h, _ = wire.decode_msg(request)
         from ..operators.operators import get as get_op
         lm = get_op("localmanager")
@@ -257,6 +295,7 @@ class AgentServer:
     # -- Trace-resource RPCs (ref: §3.5 — the CRD path served remotely) -----
 
     def apply_trace(self, request: bytes, context) -> bytes:
+        _tm_rpc.labels(method="ApplyTrace").inc()
         h, _ = wire.decode_msg(request)
         try:
             return wire.encode_msg({"trace": self.traces.apply(h.get("trace", {}))})
@@ -264,6 +303,7 @@ class AgentServer:
             return wire.encode_msg({"error": str(e)})
 
     def get_trace(self, request: bytes, context) -> bytes:
+        _tm_rpc.labels(method="GetTrace").inc()
         h, _ = wire.decode_msg(request)
         doc = self.traces.get(h.get("name", ""))
         if doc is None:
@@ -271,15 +311,18 @@ class AgentServer:
         return wire.encode_msg({"trace": doc})
 
     def list_traces(self, request: bytes, context) -> bytes:
+        _tm_rpc.labels(method="ListTraces").inc()
         return wire.encode_msg({"traces": self.traces.list()})
 
     def delete_trace(self, request: bytes, context) -> bytes:
+        _tm_rpc.labels(method="DeleteTrace").inc()
         h, _ = wire.decode_msg(request)
         return wire.encode_msg({"deleted": self.traces.delete(h.get("name", ""))})
 
     # -- dump-state debug RPC (ref: gadgettracermanager.go DumpState :204) --
 
     def dump_state(self, request: bytes, context) -> bytes:
+        _tm_rpc.labels(method="DumpState").inc()
         import sys
         frames = {}
         for tid, frame in sys._current_frames().items():
@@ -333,9 +376,15 @@ def _method(behavior, kind):
 def serve(address: str = "unix:///tmp/igtpu-agent.sock",
           node_name: str = "node", max_workers: int = 8,
           checkpoint_dir: str = "",
-          checkpoint_interval: float = 30.0) -> tuple[grpc.Server, AgentServer]:
-    """Start the agent (non-blocking); returns (grpc_server, agent)."""
+          checkpoint_interval: float = 30.0,
+          metrics_addr: str = "") -> tuple[grpc.Server, AgentServer]:
+    """Start the agent (non-blocking); returns (grpc_server, agent).
+    metrics_addr ('host:port', off by default) additionally serves the
+    telemetry registry as Prometheus text on GET /metrics."""
     agent = AgentServer(node_name=node_name)
+    if metrics_addr:
+        from ..telemetry import MetricsServer
+        agent.metrics_server = MetricsServer(metrics_addr).start()
     if checkpoint_dir:
         agent.start_checkpointer(checkpoint_dir, checkpoint_interval)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))

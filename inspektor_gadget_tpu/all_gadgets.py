@@ -10,6 +10,7 @@ from .gadgets.top import tcp as _top_tcp  # noqa: F401
 from .gadgets.top import block_io as _top_block_io  # noqa: F401
 from .gadgets.top import sketch as _top_sketch  # noqa: F401
 from .gadgets.top import self as _top_self  # noqa: F401
+from .gadgets.top import metrics as _top_metrics  # noqa: F401
 from .gadgets.snapshot import process as _snap_process  # noqa: F401
 from .gadgets.snapshot import socket as _snap_socket  # noqa: F401
 from .gadgets.profile import cpu as _profile_cpu  # noqa: F401

@@ -75,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
     up.add_argument("--context", default="", help="kubectl context for --apply")
     up.set_defaults(func=cmd_undeploy)
 
+    # help-listing stub only: main() intercepts `agent` before argparse and
+    # forwards the raw argv to agent.main serve (REMAINDER can't pass
+    # through leading --flags it doesn't own)
+    sub.add_parser(
+        "agent", help="run the per-node agent daemon (all agent.main serve "
+        "flags pass through, e.g. --listen, --metrics-addr :9100)")
+
     dr = sub.add_parser("doctor", help="probe capture windows, report "
                         "per-gadget real/degraded/unavailable status")
     dr.add_argument("-o", "--output", default="table",
@@ -167,6 +174,7 @@ def cmd_doctor(args) -> int:
     """ref: gadget-container/entrypoint.sh:21-120 environment detection,
     reshaped as an on-demand capability probe (see doctor.py)."""
     from ..doctor import gadget_report, probe_windows, render_report
+    from ..telemetry import snapshot
     windows = probe_windows()
     gadgets = gadget_report(windows)
     if args.output == "json":
@@ -174,6 +182,9 @@ def cmd_doctor(args) -> int:
         print(json.dumps({
             "windows": {k: dc.asdict(w) for k, w in windows.items()},
             "gadgets": [dc.asdict(g) for g in gadgets],
+            # the probed facts double as registry gauges; the snapshot ties
+            # this report to the same plane bench/agents expose
+            "telemetry": snapshot(),
         }, indent=2))
     else:
         print(render_report(windows, gadgets))
@@ -511,6 +522,13 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if _prev_sigint is not None:
         signal.signal(signal.SIGINT, _prev_sigint)
+    if argv is None:
+        argv = sys.argv[1:]
+    # `agent` forwards verbatim (argparse REMAINDER can't pass through
+    # leading --flags it doesn't own, e.g. `agent --metrics-addr :9100`)
+    if argv and argv[0] == "agent":
+        from ..agent.main import main as agent_main
+        return agent_main(["serve", *argv[1:]])
     ap = build_parser()
     args = ap.parse_args(argv)
     if not hasattr(args, "func"):

@@ -184,6 +184,10 @@ class Columns:
             raise TypeError(f"{event_cls!r} is not a dataclass")
         self.event_cls = event_cls
         self._columns: dict[str, Column] = {}
+        # bumped on every visibility/order change so consumers caching a
+        # compiled per-column layout (TextFormatter._fast) can invalidate
+        # with one int compare per row
+        self.layout_version = 0
         order = 0
         for f in dataclasses.fields(event_cls):
             meta = f.metadata.get("column")
@@ -274,6 +278,7 @@ class Columns:
         for c in self._columns.values():
             if tagset & set(c.tags):
                 c.visible = False
+        self.layout_version += 1
 
     def set_visible(self, names: Sequence[str]) -> None:
         """Show exactly `names`, in that order (ref: -o columns=... handling
@@ -283,6 +288,7 @@ class Columns:
             c.visible = c.name in wanted
         for i, n in enumerate(wanted):
             self.get(n).order = i
+        self.layout_version += 1
 
     # -- row access --------------------------------------------------------
 

@@ -31,15 +31,29 @@ class TextFormatter:
         self.divider = divider
         self.header_style = header_style
         self._widths: dict[str, int] = {}
+        self._fast: list | None = None
+        self._fast_version = -1
         for c in columns.visible():
             self._widths[c.name] = max(c.width, len(c.name))
         if max_width:
             self.adjust_widths(max_width)
 
+    def _width(self, c: Column) -> int:
+        """Width for a column, computing a default for columns made
+        visible after construction (set_visible with a new name)."""
+        w = self._widths.get(c.name)
+        if w is None:
+            w = self._widths[c.name] = max(c.width, len(c.name))
+        return w
+
     def adjust_widths(self, max_width: int) -> None:
         """Scale non-fixed columns proportionally to fit max_width
-        (ref: textcolumns AdjustWidthsToScreen)."""
+        (ref: textcolumns AdjustWidthsToScreen). Invalidates the compiled
+        row specs — header and rows must never disagree on widths."""
+        self._fast = None
         cols = self.columns.visible()
+        for c in cols:
+            self._width(c)  # seed widths for columns shown post-init
         total = sum(self._widths[c.name] for c in cols) + len(self.divider) * (len(cols) - 1)
         if total <= max_width:
             return
@@ -54,7 +68,7 @@ class TextFormatter:
                 self._widths[c.name] = max(c.min_width, int(self._widths[c.name] * scale))
 
     def _cell(self, c: Column, text: str) -> str:
-        w = self._widths[c.name]
+        w = self._width(c)
         text = truncate(text, w, c.ellipsis)
         return text.rjust(w) if c.align == "right" else text.ljust(w)
 
@@ -68,13 +82,17 @@ class TextFormatter:
     def _compile_fast(self) -> list:
         """Precompute per-column (getter, width, align, ...) so the
         per-event path (the display hot loop) does no sorted() rebuild,
-        no field-string split, no method dispatch."""
+        no field-string split, no method dispatch. Recompiled whenever
+        adjust_widths runs or the Columns visibility/order changes
+        (layout_version) — stale specs would render rows that disagree
+        with the header."""
         specs = []
         for c in self.columns.visible():
             get = c.extractor or operator.attrgetter(c.field)
-            specs.append((get, c.precision, self._widths[c.name],
+            specs.append((get, c.precision, self._width(c),
                           c.align == "right", c.ellipsis))
         self._fast = specs
+        self._fast_version = self.columns.layout_version
         return specs
 
     def format_event(self, event: Any) -> str:
@@ -82,7 +100,9 @@ class TextFormatter:
             cells = [self._cell(c, c.format_value(c.value(event)))
                      for c in self.columns.visible()]
             return self.divider.join(cells).rstrip()
-        specs = getattr(self, "_fast", None) or self._compile_fast()
+        specs = self._fast
+        if specs is None or self._fast_version != self.columns.layout_version:
+            specs = self._compile_fast()
         cells = []
         for get, precision, w, right, ell in specs:
             v = get(event)

@@ -19,6 +19,18 @@ import dataclasses
 import os
 import socket
 
+from .telemetry import gauge
+
+# probed platform facts as registry gauges: scrapes and embedded snapshots
+# (bench.py, cmd_doctor --output json) carry degraded/unavailable windows
+# as data, not hand-assembled prose
+_tm_window_ok = gauge("ig_doctor_window_ok",
+                      "capture window probe result (1 ok, 0 down)",
+                      ("window",))
+_tm_gadget_status = gauge("ig_doctor_gadgets",
+                          "registered gadgets per doctor status",
+                          ("status",))
+
 
 @dataclasses.dataclass
 class Window:
@@ -264,6 +276,7 @@ def probe_windows() -> dict[str, Window]:
     for probe in _PROBES:
         w = probe()
         out[w.name] = w
+        _tm_window_ok.labels(window=w.name).set(1.0 if w.ok else 0.0)
     return out
 
 
@@ -415,6 +428,11 @@ def gadget_report(windows: dict[str, Window] | None = None) -> list[GadgetStatus
             out.append(GadgetStatus(desc.category, desc.name, "unavailable",
                                     window, detail))
     out.sort(key=lambda g: (g.category, g.name))
+    counts: dict[str, int] = {}
+    for g in out:
+        counts[g.status] = counts.get(g.status, 0) + 1
+    for status in ("real", "degraded", "unavailable", "synthetic-only"):
+        _tm_gadget_status.labels(status=status).set(counts.get(status, 0))
     return out
 
 

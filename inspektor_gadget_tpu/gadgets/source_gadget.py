@@ -18,8 +18,26 @@ from ..params import ParamDesc, ParamDescs, TypeHint
 from ..sources import EventBatch, PySyntheticSource
 from ..sources.bridge import NativeCapture, native_available
 from ..sources.bridge import make_cfg as B_make_cfg
+from ..telemetry import counter, gauge
 from .context import GadgetContext
 from .interface import GadgetDesc
+
+# capture-plane telemetry, batch-grain (one lock touch per pop, never per
+# event — the pop loop is the display-path ceiling)
+_tm_batches = counter("ig_source_batches_total",
+                      "batches popped from capture sources", ("gadget",))
+_tm_events = counter("ig_source_events_total",
+                     "events popped from capture sources", ("gadget",))
+_tm_filtered = counter("ig_source_events_filtered_total",
+                       "events removed by kind/mntns filters", ("gadget",))
+_tm_dropped = counter("ig_source_events_dropped_total",
+                      "upstream capture-ring drops", ("gadget",))
+_tm_queue = gauge("ig_source_queue_events",
+                  "events in the last pop (pinned at batch-size under "
+                  "backlog)", ("gadget",))
+_tm_rows = counter("ig_display_rows_total",
+                   "rows surviving display filters and decoded for output",
+                   ("gadget",))
 
 
 def source_params() -> ParamDescs:
@@ -265,6 +283,13 @@ class SourceTraceGadget:
         self._seed = p.get("seed").as_int() if "seed" in p else 0
         self._batch_size = p.get("batch-size").as_int() if "batch-size" in p else 8192
         self.source = None
+        g = ctx.desc.full_name
+        self._m_batches = _tm_batches.labels(gadget=g)
+        self._m_events = _tm_events.labels(gadget=g)
+        self._m_filtered = _tm_filtered.labels(gadget=g)
+        self._m_dropped = _tm_dropped.labels(gadget=g)
+        self._m_queue = _tm_queue.labels(gadget=g)
+        self._m_rows = _tm_rows.labels(gadget=g)
 
     # capability protocols --------------------------------------------------
 
@@ -437,8 +462,20 @@ class SourceTraceGadget:
                     if batch.count == 0:
                         continue
                     got += batch.count
+                    popped = batch.count
+                    self._m_batches.inc()
+                    self._m_events.inc(popped)
+                    self._m_queue.set(popped)
+                    # baseline lives ON the source (a dict keyed by id(src)
+                    # would survive the source and alias a recycled id)
+                    prev_drops = getattr(src, "_tm_drops_seen", 0)
+                    if batch.drops > prev_drops:
+                        self._m_dropped.inc(batch.drops - prev_drops)
+                        src._tm_drops_seen = batch.drops
                     self._apply_kind_filter(batch)
                     self._apply_filter(batch)
+                    if batch.count != popped:
+                        self._m_filtered.inc(popped - batch.count)
                     if batch.count:
                         self.process_batch(batch)
                     if batch.count and self._batch_handler is not None:
@@ -579,10 +616,14 @@ class SourceTraceGadget:
         # (e.g. audit/seccomp's non-denial syscalls) — those must be
         # skipped BEFORE filtering, not handed to match_event
         handler = self._event_handler
+        shown = 0
         if not self._display_filters:
             for ev in self.decode_rows(batch, range(batch.count)):
                 if ev is not None:
                     handler(ev)
+                    shown += 1
+            if shown:
+                self._m_rows.inc(shown)
             return
         mask, residual = self._display_batch_mask(batch)
         idx = np.flatnonzero(mask) if mask is not None else range(batch.count)
@@ -592,10 +633,14 @@ class SourceTraceGadget:
             for ev in self.decode_rows(batch, idx):
                 if ev is not None and match_event(ev, residual, cols):
                     handler(ev)
+                    shown += 1
         else:
             for ev in self.decode_rows(batch, idx):
                 if ev is not None:
                     handler(ev)
+                    shown += 1
+        if shown:
+            self._m_rows.inc(shown)
 
     def resolve_keys_bulk(self, keys: np.ndarray) -> list[str]:
         """Resolve many key hashes with one native crossing PER SOURCE —

@@ -23,6 +23,7 @@ trace_controller.go reconcile loop without client-go.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import logging
 import threading
@@ -258,7 +259,14 @@ class TraceStore:
                 and incoming.spec.node != self.reconciler.node_name):
             return trace_to_doc(incoming)
         with self._mu:
-            existing = self._traces.get(incoming.name)
+            stored = self._traces.get(incoming.name)
+            # reconcile works on a private COPY and the store is only
+            # updated (swapped whole) after reconcile completes: mutating
+            # the stored resource in place would let a concurrent
+            # get()/list() observe the updated spec with stale status
+            # (torn read — spec and status must always be one consistent
+            # generation)
+            existing = copy.deepcopy(stored)
         if existing is not None:
             if incoming.spec.gadget and incoming.spec != existing.spec:
                 # a spec update is only safe while nothing runs against the
@@ -271,6 +279,8 @@ class TraceStore:
                     # with it intact would re-fire the rejected op forever
                     existing.annotations.update(incoming.annotations)
                     existing.annotations.pop(OPERATION_ANNOTATION, None)
+                    with self._mu:
+                        self._traces[existing.name] = existing
                     return trace_to_doc(existing)
                 existing.spec = incoming.spec
             # operations arrive as annotations on the stored resource

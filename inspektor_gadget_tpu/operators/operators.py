@@ -14,11 +14,23 @@ columnar hot path; the per-event enrich() remains for the formatter path.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Iterable
 
 from ..gadgets.context import GadgetContext
 from ..gadgets.interface import GadgetDesc
 from ..params import Collection, ParamDescs, Params
+from ..telemetry import counter, histogram
+
+# chain telemetry: batch-grain only (the per-event enrich() path stays
+# uninstrumented — at millions of rows/sec even a perf_counter pair would
+# be measurable; batches carry thousands of events each)
+_enrich_seconds = histogram(
+    "ig_operator_enrich_seconds",
+    "per-operator enrich_batch latency", ("operator",))
+_gadget_events = counter(
+    "ig_gadget_events_total",
+    "events through each gadget's operator chain", ("gadget",))
 
 
 class Operator:
@@ -88,9 +100,22 @@ class Operators(list):
             inst.enrich(event)
         return event
 
+    def _spans(self) -> list[tuple[Any, Any]]:
+        spans = getattr(self, "_tm_spans", None)
+        if spans is None or len(spans) != len(self):
+            spans = [(inst, _enrich_seconds.labels(operator=inst.name))
+                     for inst in self]
+            self._tm_spans = spans
+        return spans
+
     def enrich_batch(self, batch: Any) -> Any:
-        for inst in self:
+        for inst, hist in self._spans():
+            t0 = time.perf_counter()
             inst.enrich_batch(batch)
+            hist.observe(time.perf_counter() - t0)
+        events = getattr(self, "gadget_events", None)
+        if events is not None and batch.count:
+            events.inc(batch.count)
         return batch
 
 
@@ -209,6 +234,7 @@ def install_operators(
     (ref: runtime/local/local.go:100-133 install sequence)."""
     ops = operators if operators is not None else get_operators_for_gadget(ctx.desc)
     instances = Operators()
+    instances.gadget_events = _gadget_events.labels(gadget=ctx.desc.full_name)
     for op in ops:
         with _init_lock:
             if op.name not in _initialized:

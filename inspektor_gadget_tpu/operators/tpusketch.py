@@ -34,7 +34,36 @@ from ..ops import bundle_init, fold64_to_32
 from ..ops.sketches import bundle_digest_jit, bundle_update_jit, decode_digest
 from ..params import ParamDesc, ParamDescs, Params, TypeHint
 from ..sources.batch import EventBatch
+from ..telemetry import counter, histogram
+from ..utils.logger import get_logger
 from .operators import Operator, OperatorInstance, register
+
+# device-plane telemetry (batch-grain; the histograms time dispatch-side —
+# device completion is async and surfaces in the next blocking read)
+_tm_events = counter("ig_tpusketch_events_total",
+                     "events absorbed by the sketch plane", ("gadget",))
+_tm_steps = counter("ig_tpusketch_steps_total",
+                    "bundle_update device steps", ("gadget",))
+_tm_drops = counter("ig_tpusketch_drops_total",
+                    "upstream drops folded into the bundle", ("gadget",))
+_tm_harvests = counter("ig_tpusketch_harvests_total",
+                       "harvest ticks", ("gadget",))
+_tm_h2d = histogram("ig_tpusketch_h2d_seconds",
+                    "host→device batch staging (pad/fold + transfer "
+                    "dispatch)", ("gadget",))
+_tm_update = histogram("ig_tpusketch_update_seconds",
+                       "bundle_update step dispatch", ("gadget",))
+_tm_harvest_s = histogram("ig_tpusketch_harvest_seconds",
+                          "digest D2H + decode + scoring per harvest tick",
+                          ("gadget",))
+_tm_merge_s = histogram("ig_tpusketch_merge_seconds",
+                        "bundle_merge latency (checkpoint resume)")
+_tm_ckpt_ok = counter("ig_tpusketch_checkpoints_total",
+                      "successful sketch-state checkpoints")
+_tm_ckpt_fail = counter("ig_tpusketch_checkpoint_failures_total",
+                        "failed sketch-state checkpoint attempts")
+
+_ckpt_log = get_logger("ig-tpu.tpusketch")
 
 
 @dataclasses.dataclass
@@ -83,15 +112,30 @@ def live_instances() -> list["TpuSketchInstance"]:
         return list(_live.values())
 
 
+def _checkpoint_logged(inst: "TpuSketchInstance", retries: int = 1) -> bool:
+    """One instance save with failure accounting: failures are logged and
+    counted (checkpoint_failures_total), then retried immediately —
+    transient device reads (donated-buffer races used to be one; tunnel
+    blips still are) usually succeed on the second attempt. Never raises."""
+    for attempt in range(1 + retries):
+        try:
+            inst.checkpoint()
+            _tm_ckpt_ok.inc()
+            return True
+        except Exception as e:  # noqa: BLE001 — one bad save must not stop the rest
+            _tm_ckpt_fail.inc()
+            _ckpt_log.warning(
+                "checkpoint of %s failed (attempt %d/%d): %r",
+                getattr(inst, "_ckpt_key", "?"), attempt + 1, 1 + retries, e)
+    return False
+
+
 def checkpoint_all() -> int:
     """Save every live sketch instance; returns how many were saved."""
     saved = 0
     for inst in live_instances():
-        try:
-            inst.checkpoint()
+        if _checkpoint_logged(inst):
             saved += 1
-        except Exception:  # noqa: BLE001 — one bad save must not stop the rest
-            pass
     return saved
 
 
@@ -150,6 +194,18 @@ class TpuSketchInstance(OperatorInstance):
         self.distinct_col = p.get("distinct-column").as_string()
         self.dist_col = p.get("dist-column").as_string()
         self.harvest_interval = p.get("harvest-interval").as_duration() or 1.0
+        # serializes bundle read/update: bundle_update_jit DONATES its
+        # input, so the checkpointer thread reading self.bundle while the
+        # run thread dispatches an update would read deleted buffers
+        self._bundle_mu = threading.Lock()
+        g = ctx.desc.full_name
+        self._m_events = _tm_events.labels(gadget=g)
+        self._m_steps = _tm_steps.labels(gadget=g)
+        self._m_drops = _tm_drops.labels(gadget=g)
+        self._m_harvests = _tm_harvests.labels(gadget=g)
+        self._m_h2d = _tm_h2d.labels(gadget=g)
+        self._m_update = _tm_update.labels(gadget=g)
+        self._m_harvest_s = _tm_harvest_s.labels(gadget=g)
         self.bundle = bundle_init(
             depth=p.get("depth").as_int(),
             log2_width=p.get("log2-width").as_int(),
@@ -226,6 +282,7 @@ class TpuSketchInstance(OperatorInstance):
             out[:n] = k
             return out
 
+        t0 = time.perf_counter()
         hh = keys_for(self.hh_col)
         distinct = hh if self.distinct_col == self.hh_col else keys_for(self.distinct_col)
         dist = hh if self.dist_col == self.hh_col else keys_for(self.dist_col)
@@ -233,11 +290,22 @@ class TpuSketchInstance(OperatorInstance):
         mask[:n] = True
         new_drops = batch.drops - self._drops_seen
         self._drops_seen = batch.drops
-        self.bundle = bundle_update_jit(
-            self.bundle, jnp.asarray(hh), jnp.asarray(distinct),
-            jnp.asarray(dist), jnp.asarray(mask),
-            jnp.float32(max(new_drops, 0)),
-        )
+        hh_d, distinct_d, dist_d, mask_d = (
+            jnp.asarray(hh), jnp.asarray(distinct), jnp.asarray(dist),
+            jnp.asarray(mask))
+        t1 = time.perf_counter()
+        with self._bundle_mu:
+            self.bundle = bundle_update_jit(
+                self.bundle, hh_d, distinct_d, dist_d, mask_d,
+                jnp.float32(max(new_drops, 0)),
+            )
+        t2 = time.perf_counter()
+        self._m_h2d.observe(t1 - t0)
+        self._m_update.observe(t2 - t1)
+        self._m_events.inc(n)
+        self._m_steps.inc()
+        if new_drops > 0:
+            self._m_drops.inc(new_drops)
         self._stats.steps += 1
         self._stats.events += n
         self._stats.drops = batch.drops
@@ -307,10 +375,15 @@ class TpuSketchInstance(OperatorInstance):
     # harvest ---------------------------------------------------------------
 
     def harvest(self) -> SketchSummary:
+        t0 = time.perf_counter()
         # one packed digest: a single D2H transfer per tick, not 6 (each
-        # read through the tunnel is tens of ms)
+        # read through the tunnel is tens of ms); dispatched under the
+        # bundle lock so a concurrent update can't donate the buffers
+        # mid-read
+        with self._bundle_mu:
+            digest = bundle_digest_jit(self.bundle)
         events_f, drops_f, distinct, entropy_bits, keys, counts = (
-            decode_digest(bundle_digest_jit(self.bundle)))
+            decode_digest(digest))
         order = np.argsort(-counts)
         hh = [(int(keys[i]), int(counts[i])) for i in order if keys[i] != 0]
         anomaly = None
@@ -341,6 +414,8 @@ class TpuSketchInstance(OperatorInstance):
         )
         if self.on_summary is not None:
             self.on_summary(summary)
+        self._m_harvests.inc()
+        self._m_harvest_s.observe(time.perf_counter() - t0)
         return summary
 
     def post_gadget_run(self) -> None:
@@ -348,10 +423,9 @@ class TpuSketchInstance(OperatorInstance):
             self.harvest()
             self._stats.unregister()
             if _ckpt_dir is not None:
-                try:
-                    self.checkpoint()
-                except Exception:  # noqa: BLE001 — shutdown save best-effort
-                    pass
+                # shutdown save stays best-effort, but failures are now
+                # logged, counted, and retried — never silently swallowed
+                _checkpoint_logged(self)
             with _live_mu:
                 _live.pop(self.ctx.run_id, None)
 
@@ -371,7 +445,8 @@ class TpuSketchInstance(OperatorInstance):
         # state, never a refusal to start
         try:
             prior = load_pytree(base, like=self.bundle)
-            self.bundle = bundle_merge(self.bundle, prior)
+            with _tm_merge_s.time():
+                self.bundle = bundle_merge(self.bundle, prior)
         except Exception:  # noqa: BLE001
             pass
         if self.scorer is not None:
@@ -384,14 +459,26 @@ class TpuSketchInstance(OperatorInstance):
     def checkpoint(self) -> None:
         """Host-offload + save current state. Two concurrent runs of the
         same gadget share the key (last writer wins) — merge-on-resume
-        still never loses the surviving writer's counts."""
+        still never loses the surviving writer's counts.
+
+        The bundle is snapshotted to HOST arrays under _bundle_mu: the
+        run thread's next bundle_update_jit donates (deletes) the buffers
+        being read, so an unlocked save from the checkpointer thread hits
+        'array has been deleted' mid-write. The slow file write happens
+        outside the lock on host copies the device can't invalidate."""
         if _ckpt_dir is None:
             return
+        import jax
+
         from ..utils.checkpoint import save_pytree
         base = _ckpt_dir / self._ckpt_key
-        save_pytree(base, self.bundle)
-        if self.scorer is not None:
-            save_pytree(Path(str(base) + "-scorer"), self.scorer)
+        with self._bundle_mu:
+            bundle_host = jax.tree.map(np.asarray, self.bundle)
+            scorer_host = (jax.tree.map(np.asarray, self.scorer)
+                           if self.scorer is not None else None)
+        save_pytree(base, bundle_host)
+        if scorer_host is not None:
+            save_pytree(Path(str(base) + "-scorer"), scorer_host)
 
     # display helpers -------------------------------------------------------
 

@@ -15,15 +15,33 @@ nodes don't share a TPU slice.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable
 
 from ..gadgets.context import GadgetContext
 from ..gadgets.interface import GadgetType
 from ..params import ParamDesc, ParamDescs
 from ..snapshotcombiner import SnapshotCombiner
+from ..telemetry import counter, gauge
 from .runtime import CombinedGadgetResult, GadgetResult, Runtime
 
 STOP_RESULT_TIMEOUT = 30.0  # ref: grpc-runtime.go:347-353
+
+# fan-out telemetry: message-grain per node (a message carries a row array
+# or batch); lag is read at SCRAPE time as the age of the node's last
+# message — a node whose gauge grows while its peers' stay flat is stalled
+# or unreachable (an on-message gauge would freeze at its last healthy
+# value during exactly that outage)
+_tm_node_events = counter("ig_runtime_node_events_total",
+                          "rows received from each node's stream", ("node",))
+_tm_node_errors = counter("ig_runtime_node_errors_total",
+                          "per-node gadget-run errors", ("node",))
+_tm_node_gaps = counter("ig_runtime_node_gaps_total",
+                        "events lost in transit per node (seq gaps)",
+                        ("node",))
+_tm_node_lag = gauge("ig_runtime_node_stream_lag_seconds",
+                     "seconds since each node's last stream message "
+                     "(grows while a node is stalled)", ("node",))
 
 
 class GrpcRuntime(Runtime):
@@ -113,13 +131,26 @@ class GrpcRuntime(Runtime):
         results_mu = threading.Lock()
         stop_event = threading.Event()
 
+        last_msg = {n: time.monotonic() for n in nodes}
+        for n in nodes:
+            # scrape-time age: keeps growing while the node is silent
+            _tm_node_lag.labels(node=n).set_function(
+                lambda n=n: time.monotonic() - last_msg[n])
+
+        def _mark(node: str, events: int):
+            last_msg[node] = time.monotonic()
+            if events:
+                _tm_node_events.labels(node=node).inc(events)
+
         def on_json(node: str, row: dict):
+            _mark(node, 1)
             if on_event is not None and cols is not None:
                 ev = cols.from_dict(row)
                 ev.node = ev.node or node
                 on_event(ev)
 
         def on_array(node: str, rows: list):
+            _mark(node, len(rows))
             if cols is None:
                 return
             evs = []
@@ -151,10 +182,14 @@ class GrpcRuntime(Runtime):
                 with results_mu:
                     results[node] = GadgetResult(result=res.get("result"),
                                                  error=res.get("error"))
+                    if res.get("error"):
+                        _tm_node_errors.labels(node=node).inc()
                     if res.get("gaps"):
+                        _tm_node_gaps.labels(node=node).inc(res["gaps"])
                         ctx.logger.warning("[%s] %d events lost in transit",
                                            node, res["gaps"])
             except Exception as e:  # per-node isolation (runtime.go:42-79)
+                _tm_node_errors.labels(node=node).inc()
                 with results_mu:
                     results[node] = GadgetResult(error=str(e))
 

@@ -1,0 +1,30 @@
+"""Framework-wide telemetry plane (metrics registry + spans + exposition).
+
+Usage:
+    from ..telemetry import counter, gauge, histogram
+    _events = counter("ig_source_events_total", "events popped", ("gadget",))
+    _events.labels(gadget="trace/exec").inc(batch.count)
+
+    with histogram("ig_op_enrich_seconds").time():
+        ...
+
+Exposed via telemetry/http.py (Prometheus text over --metrics-addr), the
+`top metrics` gadget, and snapshot() embedded in bench/doctor JSON.
+"""
+
+from .registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricFamily,
+    REGISTRY,
+    Registry,
+    Span,
+    counter,
+    gauge,
+    histogram,
+    render_prometheus,
+    snapshot,
+)
+from .http import MetricsServer, parse_addr  # noqa: F401

@@ -1,0 +1,407 @@
+"""Telemetry plane: registry semantics, exposition, pipeline coverage,
+and regression tests for the three round-6 bugfixes (checkpoint swallow,
+stale formatter specs, TraceStore torn read).
+
+Unit tests use private Registry() instances; end-to-end assertions read
+DELTAS of the process-wide default registry (resetting it would orphan the
+module-level children instrumented code holds)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu import telemetry
+from inspektor_gadget_tpu.columns import Columns, TextFormatter, col
+from inspektor_gadget_tpu.gadgets import GadgetContext, get
+from inspektor_gadget_tpu.params import Collection
+from inspektor_gadget_tpu.runtime.local import LocalRuntime
+from inspektor_gadget_tpu.telemetry import MetricsServer, Registry
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_semantics():
+    r = Registry()
+    c = r.counter("req_total", "requests", ("method",))
+    c.labels(method="GET").inc()
+    c.labels(method="GET").inc(2)
+    c.labels(method="PUT").inc(5)
+    assert c.labels(method="GET").value == 3
+    assert c.labels(method="PUT").value == 5
+    with pytest.raises(ValueError):
+        c.labels(method="GET").inc(-1)
+    with pytest.raises(ValueError):
+        c.labels(verb="GET")  # wrong label name
+
+
+def test_gauge_semantics():
+    r = Registry()
+    g = r.gauge("depth")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3
+    g.set_function(lambda: 42)
+    assert g.value == 42
+    g.set_function(lambda: 1 / 0)  # dead callback reads as 0, not a crash
+    assert g.value == 0
+
+
+def test_histogram_buckets_fixed_log_scale():
+    r = Registry()
+    h = r.histogram("lat_seconds", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.005, 0.005, 0.05, 5.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(5.0605)
+    # cumulative buckets: (le, count<=le)
+    assert h.buckets() == [(0.001, 1), (0.01, 3), (0.1, 4),
+                           (float("inf"), 5)]
+    # a value exactly on a bound counts into that bound's bucket
+    h.observe(0.01)
+    assert h.buckets()[1] == (0.01, 4)
+    with pytest.raises(ValueError):
+        r.histogram("bad_seconds", buckets=(0.1, 0.1))
+
+
+def test_get_or_create_idempotent_and_kind_checked():
+    r = Registry()
+    a = r.counter("x_total", "first", ("k",))
+    b = r.counter("x_total", "second registration ignored", ("k",))
+    assert a is b
+    with pytest.raises(ValueError):
+        r.gauge("x_total")
+    with pytest.raises(ValueError):
+        r.counter("x_total", labels=("other",))
+    h = r.histogram("h_seconds", buckets=(0.1, 1.0))
+    assert r.histogram("h_seconds") is h  # None = no opinion on buckets
+    assert r.histogram("h_seconds", buckets=(0.1, 1.0)) is h
+    with pytest.raises(ValueError):
+        r.histogram("h_seconds", buckets=(5.0,))
+
+
+def test_concurrent_increments_are_exact():
+    r = Registry()
+    c = r.counter("n_total")
+    h = r.histogram("h_seconds", buckets=(1.0,))
+
+    def work():
+        for _ in range(5000):
+            c.inc()
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 40000
+    assert h.count == 40000
+    assert h.buckets() == [(1.0, 40000), (float("inf"), 40000)]
+
+
+def test_prometheus_text_rendering():
+    r = Registry()
+    r.counter("ev_total", "events seen", ("gadget",)).labels(
+        gadget='trace/exec "x"\nline').inc(7)
+    r.gauge("depth").set(2.5)
+    r.histogram("lat_seconds", "latency", buckets=(0.01, 1.0)).observe(0.5)
+    text = r.render_prometheus()
+    assert "# HELP ev_total events seen" in text
+    assert "# TYPE ev_total counter" in text
+    # label value escaping: backslash, quote, newline
+    assert 'ev_total{gadget="trace/exec \\"x\\"\\nline"} 7' in text
+    assert "# TYPE depth gauge" in text
+    assert "depth 2.5" in text
+    assert "# TYPE lat_seconds histogram" in text
+    assert 'lat_seconds_bucket{le="0.01"} 0' in text
+    assert 'lat_seconds_bucket{le="1.0"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "lat_seconds_sum 0.5" in text
+    assert "lat_seconds_count 1" in text
+
+
+def test_snapshot_deterministic():
+    r = Registry()
+    # registration order must not leak into the snapshot order
+    r.counter("z_total").inc(1)
+    r.counter("a_total", labels=("x",)).labels(x="2").inc(2)
+    r.counter("a_total", labels=("x",)).labels(x="1").inc(1)
+    s1 = r.snapshot()
+    s2 = r.snapshot()
+    assert s1 == s2
+    assert list(s1) == ['a_total{x="1"}', 'a_total{x="2"}', "z_total"]
+    import json
+    assert json.loads(json.dumps(s1)) == s1  # JSON-embeddable
+
+
+def test_span_timer_feeds_histogram():
+    r = Registry()
+    h = r.histogram("span_seconds", buckets=(10.0,))
+    with h.time():
+        time.sleep(0.01)
+    assert h.count == 1
+    assert 0.005 < h.sum < 5.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP exposition
+# ---------------------------------------------------------------------------
+
+def test_metrics_http_endpoint():
+    r = Registry()
+    r.counter("served_total").inc(3)
+    srv = MetricsServer("127.0.0.1:0", registry=r).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=5).read()
+        assert b"served_total 3" in body
+        assert urllib.request.urlopen(
+            f"{base}/healthz", timeout=5).read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        srv.stop()
+
+
+def test_parse_addr():
+    from inspektor_gadget_tpu.telemetry import parse_addr
+    assert parse_addr(":9100") == ("0.0.0.0", 9100)
+    assert parse_addr("127.0.0.1:80") == ("127.0.0.1", 80)
+    with pytest.raises(ValueError):
+        parse_addr("nope")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a synthetic gadget run leaves non-zero pipeline counters
+# ---------------------------------------------------------------------------
+
+def _sample(snap: dict, key: str) -> float:
+    return snap.get(key, 0.0)
+
+
+def test_gadget_run_populates_pipeline_counters():
+    before = telemetry.snapshot()
+    desc = get("trace", "exec")
+    params = desc.params().to_params()
+    params.set("source", "pysynthetic")
+    params.set("rate", "200000")
+    op_params = Collection()
+    from inspektor_gadget_tpu.operators.operators import get as get_op
+    sp = get_op("tpusketch").instance_params().to_params()
+    sp.set("enable", "true")
+    sp.set("log2-width", "8")
+    sp.set("hll-p", "6")
+    sp.set("entropy-log2-width", "6")
+    sp.set("topk", "8")
+    sp.set("harvest-interval", "200ms")
+    op_params["operator.tpusketch."] = sp
+    shown = []
+    ctx = GadgetContext(desc, gadget_params=params, operator_params=op_params,
+                        timeout=0.6)
+    result = LocalRuntime().run_gadget(ctx, on_event=shown.append)
+    assert not result.errors()
+    assert shown
+    after = telemetry.snapshot()
+
+    def delta(key):
+        return _sample(after, key) - _sample(before, key)
+
+    g = 'gadget="trace/exec"'
+    # source plane
+    assert delta(f"ig_source_events_total{{{g}}}") > 0
+    assert delta(f"ig_source_batches_total{{{g}}}") > 0
+    assert delta(f"ig_display_rows_total{{{g}}}") > 0
+    # operator chain
+    assert delta(f"ig_gadget_events_total{{{g}}}") > 0
+    assert delta('ig_operator_enrich_seconds_count{operator="tpusketch"}') > 0
+    # tpusketch device plane
+    assert delta(f"ig_tpusketch_events_total{{{g}}}") > 0
+    assert delta(f"ig_tpusketch_steps_total{{{g}}}") > 0
+    assert delta(f"ig_tpusketch_update_seconds_count{{{g}}}") > 0
+    assert delta(f"ig_tpusketch_harvests_total{{{g}}}") > 0
+
+
+def test_top_metrics_gadget_renders_registry():
+    telemetry.counter("ig_test_rows_total").inc(5)
+    desc = get("top", "metrics")
+    ctx = GadgetContext(desc)
+    gadget = desc.new_instance(ctx)
+    gadget.setup(ctx)
+    telemetry.counter("ig_test_rows_total").inc(7)
+    rows = gadget.collect(ctx)
+    by_name = {(r.metric, r.labels): r for r in rows}
+    row = by_name[("ig_test_rows_total", "")]
+    assert row.value == 12
+    assert row.kind == "counter"
+    assert row.rate > 0  # the 7 incremented since setup()
+    # histogram buckets are elided; _count/_sum remain
+    assert not any(r.metric.endswith("_bucket") for r in rows)
+    # rows render through the ordinary column system
+    cols = desc.columns()
+    formatter = TextFormatter(cols)
+    line = formatter.format_event(row)
+    assert "ig_test_rows_total" in line
+
+
+# ---------------------------------------------------------------------------
+# bugfix regressions
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Ev:
+    comm: str = col("", width=16)
+    pid: int = col(0, width=7, align="right", dtype=np.int32)
+    secret: int = col(0, hide=True, dtype=np.int32)
+
+
+def test_formatter_specs_follow_adjust_widths():
+    """Regression: adjust_widths after the first row used to leave stale
+    compiled specs — rows kept old widths while the header shrank."""
+    cols = Columns(_Ev)
+    f = TextFormatter(cols)
+    ev = _Ev(comm="a-rather-long-comm", pid=42)
+    f.format_event(ev)  # compiles the fast specs at full width
+    f.adjust_widths(14)
+    fresh = TextFormatter(Columns(_Ev), max_width=14)
+    assert f.header() == fresh.header()
+    assert f.format_event(ev) == fresh.format_event(ev)
+
+
+def test_formatter_specs_follow_visibility_changes():
+    """Regression: set_visible after the first row used to keep rendering
+    the old column set (and KeyError on newly-shown hidden columns)."""
+    cols = Columns(_Ev)
+    f = TextFormatter(cols)
+    ev = _Ev(comm="bash", pid=7, secret=99)
+    assert "99" not in f.format_event(ev)
+    cols.set_visible(["pid", "secret"])
+    row = f.format_event(ev)
+    assert "bash" not in row
+    assert "99" in row
+    assert f.header().split() == ["PID", "SECRET"]
+
+
+def test_trace_store_readers_never_see_torn_state():
+    """Regression: apply() used to mutate the stored resource in place, so
+    a concurrent get() could observe the NEW spec with the OLD status."""
+    from inspektor_gadget_tpu.gadgets.trace_resource import TraceStore
+    store = TraceStore(node_name="n1")
+    store.apply({"metadata": {"name": "t1"},
+                 "spec": {"gadget": "g/old"}})
+
+    def slow_reconcile(trace):
+        time.sleep(0.15)  # window in which readers sample
+        trace.status.state = "Reconciled"
+        return trace
+
+    store.reconciler.reconcile = slow_reconcile
+    t = threading.Thread(target=store.apply, args=(
+        {"metadata": {"name": "t1"}, "spec": {"gadget": "g/new"}},))
+    t.start()
+    torn = []
+    while t.is_alive():
+        doc = store.get("t1")
+        if (doc["spec"]["gadget"] == "g/new"
+                and doc["status"]["state"] != "Reconciled"):
+            torn.append(doc)
+        time.sleep(0.002)
+    t.join()
+    assert not torn, f"reader saw new spec with stale status: {torn[0]}"
+    assert store.get("t1")["status"]["state"] == "Reconciled"
+
+
+@pytest.fixture()
+def sketch_instance(tmp_path):
+    from inspektor_gadget_tpu.operators import tpusketch
+    from inspektor_gadget_tpu.operators.operators import get as get_op
+    tpusketch.set_checkpoint_dir(tmp_path)
+    desc = get("trace", "exec")
+    ctx = GadgetContext(desc)
+    op = get_op("tpusketch")
+    p = op.instance_params().to_params()
+    p.set("enable", "true")
+    p.set("log2-width", "8")
+    p.set("hll-p", "6")
+    p.set("entropy-log2-width", "6")
+    p.set("topk", "8")
+    inst = op.instantiate(ctx, None, p)
+    yield tmp_path, inst
+    from inspektor_gadget_tpu.operators.tpusketch import _live, _live_mu
+    with _live_mu:
+        _live.pop(ctx.run_id, None)
+    tpusketch.set_checkpoint_dir(None)
+
+
+def test_checkpoint_failure_logged_counted_retried(
+        sketch_instance, monkeypatch, caplog):
+    """Regression: checkpoint failures used to be `except: pass` — now
+    they are logged, bump checkpoint_failures_total, and retry once."""
+    import logging
+
+    from inspektor_gadget_tpu.operators import tpusketch
+    from inspektor_gadget_tpu.utils import checkpoint as ckpt_mod
+    _tmp, inst = sketch_instance
+    fail_before = tpusketch._tm_ckpt_fail.value
+    ok_before = tpusketch._tm_ckpt_ok.value
+    calls = []
+
+    def boom(*a, **kw):
+        calls.append(1)
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(ckpt_mod, "save_pytree", boom)
+    with caplog.at_level(logging.WARNING, logger="ig-tpu.tpusketch"):
+        assert tpusketch.checkpoint_all() == 0
+    assert len(calls) == 2  # immediate retry happened
+    assert tpusketch._tm_ckpt_fail.value == fail_before + 2
+    assert any("checkpoint of trace-exec failed" in r.message
+               for r in caplog.records)
+
+    monkeypatch.undo()
+    assert tpusketch.checkpoint_all() == 1
+    assert tpusketch._tm_ckpt_ok.value == ok_before + 1
+    assert (_tmp / "trace-exec.npz").exists()
+
+
+def test_checkpoint_snapshots_bundle_under_update_pressure(sketch_instance):
+    """The checkpointer must survive concurrent enrich_batch updates:
+    bundle_update_jit donates its input, so an unlocked reader would hit
+    deleted device buffers."""
+    from inspektor_gadget_tpu.operators import tpusketch
+    from inspektor_gadget_tpu.sources.synthetic import PySyntheticSource
+    _tmp, inst = sketch_instance
+    src = PySyntheticSource(seed=3, batch_size=512)
+    stop = threading.Event()
+    errors = []
+
+    def pump():
+        try:
+            while not stop.is_set():
+                inst.enrich_batch(src.generate(512))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=pump)
+    t.start()
+    try:
+        deadline = time.monotonic() + 1.5
+        saves = 0
+        while time.monotonic() < deadline:
+            inst.checkpoint()
+            saves += 1
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    assert not errors
+    assert saves > 0
+    assert (_tmp / "trace-exec.npz").exists()
