@@ -254,7 +254,7 @@ def _spawn(args: list[str], timeout: float) -> tuple[dict | None, str]:
     return None, "no JSON line in child output"
 
 
-def main() -> None:
+def main(forced: str | None = None) -> None:
     extra: dict = {"pipeline":
                    "gen(C++)->fold32->H2D->bundle_update, depth-4 queue"}
     try:
@@ -262,10 +262,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         extra["host_plane_error"] = f"{type(e).__name__}: {e}"
 
-    forced = os.environ.get("IG_BENCH_PLATFORM")  # "cpu" skips the TPU probe
+    # --platform cpu skips the TPU probe entirely; --platform tpu trusts
+    # the accelerator and skips the probe; auto/unset probes first
+    forced = forced or os.environ.get("IG_BENCH_PLATFORM")
     result = None
     errors = {}
-    if forced != "cpu":
+    if forced == "tpu":
+        result, terr = _spawn(["--child", "tpu"], TPU_CHILD_TIMEOUT_S)
+        if result is None:
+            errors["tpu"] = terr
+    elif forced != "cpu":
         probe, perr = _spawn(["--probe"], PROBE_TIMEOUT_S)
         # a probe that resolves to the CPU backend means there is no
         # accelerator — running the production shapes there would burn the
@@ -304,12 +310,16 @@ def main() -> None:
     # gauges and the record carries real pipeline counters (the child's
     # device-plane counters merged with this process's host-plane ones)
     # instead of only hand-assembled extras
-    from inspektor_gadget_tpu.telemetry import gauge, snapshot
+    from inspektor_gadget_tpu.telemetry import RECORDER, gauge, snapshot
     gauge("ig_bench_degraded",
           "1 when the headline ran on a fallback platform").set(
         1.0 if extra["degraded"] else 0.0)
     gauge("ig_bench_platform_info", "platform the headline ran on",
           ("platform",)).labels(platform=extra["platform"]).set(1.0)
+    # the probed platform also lands in the flight recorder, the same
+    # black box the agent dumps on crash
+    RECORDER.set_fact("platform", extra["platform"])
+    RECORDER.set_fact("bench_degraded", extra["degraded"])
     child_tel = result.pop("telemetry", {}) if result else {}
     extra["telemetry"] = {**child_tel, **snapshot()}
 
@@ -331,4 +341,14 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--child":
         print(json.dumps(run_child(sys.argv[2])))
     else:
-        main()
+        forced_arg = None
+        if "--platform" in sys.argv:
+            i = sys.argv.index("--platform")
+            forced_arg = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+            if forced_arg not in ("auto", "tpu", "cpu"):
+                print("usage: bench.py [--platform auto|tpu|cpu]",
+                      file=sys.stderr)
+                sys.exit(2)
+            if forced_arg == "auto":
+                forced_arg = None
+        main(forced_arg)

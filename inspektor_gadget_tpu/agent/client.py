@@ -77,21 +77,25 @@ class AgentClient:
         on_array: Callable[[str, list], None] | None = None,
         on_batch: Callable[[str, Any], None] | None = None,
         on_summary: Callable[[str, dict], None] | None = None,
-        on_log: Callable[[str, int, str], None] | None = None,
+        on_log: Callable[[str, int, str, dict], None] | None = None,
         stop_event: threading.Event | None = None,
+        trace_ctx=None,
     ) -> dict:
         """Blocking run; returns {'result': bytes|None, 'error': str|None,
-        'gaps': int, 'dropped': int}."""
+        'gaps': int, 'dropped': int}. trace_ctx (a telemetry SpanContext)
+        rides the run request as a traceparent so the agent's server spans
+        join the caller's trace; on_log receives (node, severity, msg,
+        header) — the header carries the remote run_id/trace_id."""
         method = self.channel.stream_stream(
             "/igtpu.GadgetManager/RunGadget",
             request_serializer=wire.identity_serializer,
             response_deserializer=wire.identity_deserializer,
         )
         ctrl_q: queue.Queue = queue.Queue()
-        ctrl_q.put(wire.encode_msg({"run": {
+        ctrl_q.put(wire.encode_msg(wire.inject_span({"run": {
             "category": category, "name": name, "params": params or {},
             "timeout": timeout, "output": list(outputs),
-        }}))
+        }}, trace_ctx)))
 
         def requests() -> Iterator[bytes]:
             while True:
@@ -122,7 +126,8 @@ class AgentClient:
                 sev = t >> wire.EV_LOG_SHIFT
                 if sev:
                     if on_log:
-                        on_log(self.node_name, sev, payload.decode("utf-8", "replace"))
+                        on_log(self.node_name, sev,
+                               payload.decode("utf-8", "replace"), header)
                 elif t == wire.EV_PAYLOAD_JSON:
                     if on_json:
                         on_json(self.node_name, json.loads(payload))
@@ -174,14 +179,22 @@ class AgentClient:
             timeout=timeout))
         return h
 
-    def dump_state(self) -> dict:
+    def dump_state(self, max_spans: int = 0) -> dict:
         method = self.channel.unary_unary(
             "/igtpu.GadgetManager/DumpState",
             request_serializer=wire.identity_serializer,
             response_deserializer=wire.identity_deserializer,
         )
-        h, _ = wire.decode_msg(method(wire.encode_msg({}), timeout=CONNECT_TIMEOUT))
+        req = {"max_spans": max_spans} if max_spans else {}
+        h, _ = wire.decode_msg(method(wire.encode_msg(req),
+                                      timeout=CONNECT_TIMEOUT))
         return h
+
+    def flight_record(self, max_spans: int = 0) -> dict:
+        """The agent's flight recorder (recent spans/logs/errors/facts),
+        served via DumpState. max_spans>512 pulls deeper into the span
+        ring (trace export wants all of it)."""
+        return self.dump_state(max_spans=max_spans).get("flight_record", {})
 
     # -- Trace resources (ref: utils/trace.go:340-848 CreateTrace/
     #    SetTraceOperation/getTraceListFromOptions, over agent RPCs) --------

@@ -12,6 +12,7 @@ dial.
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import subprocess
@@ -20,6 +21,8 @@ import threading
 import uuid
 
 import grpc
+
+log = logging.getLogger("ig-tpu.dialer")
 
 
 class DirectDialer:
@@ -103,8 +106,8 @@ class ExecTunnelDialer:
         finally:
             try:
                 proc.stdin.close()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                log.debug("tunnel stdin close failed: %r", e)
 
     def _pump_in(self, conn: socket.socket, proc: subprocess.Popen) -> None:
         """tunnel stdout → local socket"""
@@ -149,8 +152,8 @@ class ExecTunnelDialer:
             try:
                 p.kill()
                 p.wait(timeout=2)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                log.debug("tunnel process reap failed: %r", e)
         try:
             os.unlink(self._path)
             os.rmdir(self._dir)

@@ -39,6 +39,18 @@ def main(argv=None) -> int:
     sp.add_argument("--metrics-addr", default="",
                     help="serve Prometheus text metrics on host:port "
                          "(e.g. :9100); off by default")
+    sp.add_argument("--platform", default="auto",
+                    choices=("auto", "tpu", "cpu"),
+                    help="device backend: auto probes under a hard "
+                         "timeout and degrades to cpu instead of hanging "
+                         "at first device use; cpu skips the probe")
+    sp.add_argument("--probe-timeout", type=float, default=None,
+                    help="seconds to wait for the device probe "
+                         "(default $IG_PLATFORM_PROBE_TIMEOUT or 20)")
+    sp.add_argument("--flight-record-path", default="",
+                    help="dump the flight recorder (recent spans/logs/"
+                         "errors) here on SIGTERM/crash; default "
+                         "/tmp/igtpu-flight-<node>.json, 'off' disables")
     sp.add_argument("--watch-traces", action="store_true",
                     help="reconcile Trace resources off the kube API "
                          "(requires --kube-api; controller role of "
@@ -119,6 +131,17 @@ def main(argv=None) -> int:
     if args.cmd == "serve":
         if args.watch_traces and not args.kube_api:
             ap.error("--watch-traces requires --kube-api")
+        # bounded device acquisition BEFORE first device use (VERDICT hole
+        # #1: the PJRT plugin can hang forever in backend init) — a failed
+        # or timed-out probe pins this process to CPU, logged + counted
+        from ..utils.platform_probe import DEFAULT_PROBE_TIMEOUT, acquire_platform
+        acq = acquire_platform(
+            args.platform,
+            timeout=(args.probe_timeout if args.probe_timeout is not None
+                     else DEFAULT_PROBE_TIMEOUT))
+        print(f"device platform: {acq['platform']}"
+              + (f" (degraded: {acq['detail']})" if acq["degraded"] else ""),
+              flush=True)
         # entrypoint-analogue environment probe (ref: entrypoint.sh:21-120
         # detects OS/kernel/runtime before starting the daemon): report
         # which capture windows work on this host so degraded gadgets are
@@ -155,7 +178,15 @@ def main(argv=None) -> int:
 
 
 def _serve_loop(args) -> int:
+    from ..telemetry.tracing import RECORDER, install_crash_handlers
     from .service import serve
+    # crash-safe black box: unhandled exceptions (any thread) dump the
+    # flight recorder, and the SIGTERM/SIGINT path below dumps it too —
+    # a wedged or killed agent leaves evidence of what it was doing
+    flight_path = args.flight_record_path or \
+        f"/tmp/igtpu-flight-{args.node_name}.json"
+    if flight_path != "off":
+        install_crash_handlers(flight_path, signals=())
     # bind BEFORE installing hooks: a prestart config pointing at a socket
     # nobody serves stalls every container creation on the host
     server, _agent = serve(args.listen, node_name=args.node_name,
@@ -214,7 +245,11 @@ def _serve_loop(args) -> int:
         print(f"ig-tpu-agent listening on {args.listen}", flush=True)
         stop = [False]
 
-        def on_sig(*_):
+        def on_sig(signum, *_):
+            if flight_path != "off":
+                RECORDER.record_error("signal",
+                                      f"agent stopping on signal {signum}")
+                RECORDER.dump(flight_path)
             stop[0] = True
         signal.signal(signal.SIGTERM, on_sig)
         signal.signal(signal.SIGINT, on_sig)

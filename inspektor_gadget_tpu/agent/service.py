@@ -33,6 +33,8 @@ from ..params import Collection
 from ..runtime.local import LocalRuntime
 from ..runtime.runtime import build_catalog
 from ..telemetry import counter, gauge
+from ..telemetry.tracing import RECORDER, TRACER
+from ..utils.logger import StreamLogHandler, StreamLogger
 from . import wire
 
 EVENT_BUFFER = 1024  # ref: service.go:134 bounded buffer, drop-on-full
@@ -109,6 +111,20 @@ class AgentServer:
         _tm_rpc.labels(method="RunGadget").inc()
         first = next(request_iterator)
         header, _ = wire.decode_msg(first)
+        # server span per RPC, parented to the client's fan-out span when
+        # the request carries a traceparent (one trace end to end).
+        # ambient=False: this span stays open across yields, and gRPC may
+        # resume the generator on a different worker thread — an ambient
+        # contextvar set here could strand a dead span as that thread's
+        # parent; children parent via ctx.extra explicitly instead
+        with TRACER.span("agent/RunGadget", parent=wire.extract_span(header),
+                         attrs={"node": self.node_name},
+                         ambient=False) as rpc_span:
+            yield from self._run_gadget_traced(header, rpc_span,
+                                               request_iterator, context)
+
+    def _run_gadget_traced(self, header: dict, rpc_span, request_iterator,
+                           context) -> Iterator[bytes]:
         run = header.get("run")
         if not run:
             yield wire.encode_msg({"error": "first message must be a run request"})
@@ -137,17 +153,41 @@ class AgentServer:
         )
         # run-with-result gadgets render server-side in the requested format
         ctx.extra["output"] = "json" if "result-json" in outputs else "columns"
+        # per-RUN logger (child of the shared gadget logger, so records
+        # still propagate to it and the flight recorder): the stream log
+        # handler below must only see THIS run's records — attaching to
+        # the shared logger would cross-stream concurrent runs' logs and,
+        # with an in-process client, echo received lines back out forever.
+        # Constructed directly, NOT via getLogger: the manager caches
+        # named loggers forever, and one per run would leak unbounded in
+        # a long-lived agent.
+        run_logger = logging.Logger(f"ig-tpu.{desc.full_name}.{ctx.run_id}")
+        run_logger.parent = logging.getLogger(f"ig-tpu.{desc.full_name}")
+        ctx.logger = run_logger
         with self._runs_mu:
             self._runs[ctx.run_id] = ctx
         _tm_active_runs.inc()
+        # server span per run (child of the RPC span); operators and the
+        # device plane parent their spans to this via ctx.extra —
+        # ambient=False for the same cross-thread-generator reason
+        run_span = TRACER.span(f"agent/run/{desc.full_name}",
+                               parent=rpc_span.context,
+                               attrs={"run_id": ctx.run_id,
+                                      "gadget": desc.full_name},
+                               ambient=False)
         try:
-            yield from self._run_gadget_stream(ctx, desc, outputs,
-                                               request_iterator, context)
+            with run_span:
+                ctx.extra["trace_ctx"] = run_span.context
+                yield from self._run_gadget_stream(ctx, desc, outputs,
+                                                   request_iterator, context)
         finally:
             # also reached via GeneratorExit when the client cancels the
             # stream mid-run: the run must be cancelled and accounting
             # unwound, or _runs and the active-runs gauge drift upward
             ctx.cancel()
+            handler = ctx.extra.pop("_stream_log_handler", None)
+            if handler is not None:
+                ctx.logger.removeHandler(handler)
             with self._runs_mu:
                 self._runs.pop(ctx.run_id, None)
             _tm_active_runs.dec()
@@ -171,6 +211,19 @@ class AgentServer:
             except queue.Full:
                 dropped[0] += 1  # ref: service.go:160-167 drop-on-full
                 m_dropped.inc()
+
+        # run logs multiplex onto the same stream with severity in the
+        # type bits; run/trace IDs ride the header so the client can
+        # correlate a remote log line with this run's spans
+        trace_ctx = ctx.extra.get("trace_ctx")
+        stream_log = StreamLogger(
+            push, shift=wire.EV_LOG_SHIFT, run_id=ctx.run_id,
+            trace_id=trace_ctx.trace_id if trace_ctx is not None else "")
+        log_handler = StreamLogHandler(stream_log)
+        ctx.logger.addHandler(log_handler)
+        # detached by the caller's finally: the stream can end via client
+        # cancel (GeneratorExit) anywhere in the loop below
+        ctx.extra["_stream_log_handler"] = log_handler
 
         cols = desc.columns()
 
@@ -323,6 +376,10 @@ class AgentServer:
 
     def dump_state(self, request: bytes, context) -> bytes:
         _tm_rpc.labels(method="DumpState").inc()
+        try:
+            req, _ = wire.decode_msg(request)
+        except (ValueError, json.JSONDecodeError):
+            req = {}
         import sys
         frames = {}
         for tid, frame in sys._current_frames().items():
@@ -361,14 +418,37 @@ class AgentServer:
                           for t in self.traces.list()]}
         if dump_error:
             msg["error"] = dump_error
+        # the process flight recorder (recent spans/logs/errors/facts)
+        # rides the same debug RPC, so a wedged agent can still be read;
+        # max_spans lets trace export request the whole ring instead of
+        # the 512-span debug default
+        msg["flight_record"] = RECORDER.snapshot(
+            max_spans=int(req.get("max_spans") or 512))
         return wire.encode_msg(msg)
 
 
-def _method(behavior, kind):
+def _traced_unary(name, behavior):
+    """Open a server span per unary RPC, parented to the caller's span
+    when the request header carries a traceparent."""
+    def handler(request, context):
+        parent = None
+        try:
+            h, _ = wire.decode_msg(request)
+            parent = wire.extract_span(h)
+        except (ValueError, KeyError, IndexError, UnicodeDecodeError,
+                json.JSONDecodeError):
+            parent = None
+        with TRACER.span(f"agent/{name}", parent=parent):
+            return behavior(request, context)
+    return handler
+
+
+def _method(behavior, kind, name=""):
     s, d = wire.identity_serializer, wire.identity_deserializer
     if kind == "unary":
         return grpc.unary_unary_rpc_method_handler(
-            behavior, request_deserializer=d, response_serializer=s)
+            _traced_unary(name, behavior),
+            request_deserializer=d, response_serializer=s)
     return grpc.stream_stream_rpc_method_handler(
         behavior, request_deserializer=d, response_serializer=s)
 
@@ -382,6 +462,13 @@ def serve(address: str = "unix:///tmp/igtpu-agent.sock",
     metrics_addr ('host:port', off by default) additionally serves the
     telemetry registry as Prometheus text on GET /metrics."""
     agent = AgentServer(node_name=node_name)
+    # first agent in the process names the tracer/flight-recorder identity
+    # (one agent per process in real deployments; in-process test fleets
+    # share both, so keep the two first-wins-consistent — a last-wins
+    # fact would contradict the span attribution)
+    if not TRACER.node:
+        TRACER.node = node_name
+        RECORDER.set_fact("node", node_name)
     if metrics_addr:
         from ..telemetry import MetricsServer
         agent.metrics_server = MetricsServer(metrics_addr).start()
@@ -389,15 +476,16 @@ def serve(address: str = "unix:///tmp/igtpu-agent.sock",
         agent.start_checkpointer(checkpoint_dir, checkpoint_interval)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = {
-        "GetCatalog": _method(agent.get_catalog, "unary"),
+        "GetCatalog": _method(agent.get_catalog, "unary", "GetCatalog"),
         "RunGadget": _method(agent.run_gadget, "stream"),
-        "AddContainer": _method(agent.add_container, "unary"),
-        "RemoveContainer": _method(agent.remove_container, "unary"),
-        "DumpState": _method(agent.dump_state, "unary"),
-        "ApplyTrace": _method(agent.apply_trace, "unary"),
-        "GetTrace": _method(agent.get_trace, "unary"),
-        "ListTraces": _method(agent.list_traces, "unary"),
-        "DeleteTrace": _method(agent.delete_trace, "unary"),
+        "AddContainer": _method(agent.add_container, "unary", "AddContainer"),
+        "RemoveContainer": _method(agent.remove_container, "unary",
+                                   "RemoveContainer"),
+        "DumpState": _method(agent.dump_state, "unary", "DumpState"),
+        "ApplyTrace": _method(agent.apply_trace, "unary", "ApplyTrace"),
+        "GetTrace": _method(agent.get_trace, "unary", "GetTrace"),
+        "ListTraces": _method(agent.list_traces, "unary", "ListTraces"),
+        "DeleteTrace": _method(agent.delete_trace, "unary", "DeleteTrace"),
     }
     server.add_generic_rpc_handlers((
         grpc.method_handlers_generic_handler("igtpu.GadgetManager", handlers),

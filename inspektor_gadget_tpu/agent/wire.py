@@ -16,6 +16,8 @@ import json
 
 import numpy as np
 
+from ..telemetry.tracing import TRACEPARENT, SpanContext, parse_traceparent
+
 # event types (ref: api consts; log severity rides the high bits)
 EV_PAYLOAD_JSON = 1     # one event row as JSON
 EV_PAYLOAD_ARRAY = 2    # array-of-rows JSON (interval gadgets)
@@ -78,6 +80,18 @@ def decode_summary(header: dict, payload: bytes) -> dict:
     out["heavy_hitters"] = [(int(k), int(c)) for k, c in hh]
     out["names"] = {int(k): v for k, v in (header.get("names") or {}).items()}
     return out
+
+
+def inject_span(header: dict, ctx: SpanContext | None) -> dict:
+    """Carry span context in message metadata (the W3C traceparent string
+    rides the JSON header, so agent and client stitch one trace)."""
+    if ctx is not None:
+        header[TRACEPARENT] = ctx.to_traceparent()
+    return header
+
+
+def extract_span(header: dict) -> SpanContext | None:
+    return parse_traceparent(header.get(TRACEPARENT, ""))
 
 
 def identity_serializer(b: bytes) -> bytes:

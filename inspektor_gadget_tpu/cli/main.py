@@ -88,10 +88,44 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["table", "json"])
     dr.set_defaults(func=cmd_doctor)
 
-    bp = sub.add_parser("debug", help="dump agent state (DumpState analogue)")
+    bp = sub.add_parser("debug", help="agent debugging: state dump, flight "
+                        "recorder, Chrome-trace export")
     bp.add_argument("--remote", default="",
                     help="name=target[,...]; defaults to the local fleet")
-    bp.set_defaults(func=cmd_debug)
+    bp.set_defaults(func=cmd_debug, node="")  # bare `debug` → state dump
+    bsub = bp.add_subparsers(dest="debug_verb")
+
+    # sub-verb flags use SUPPRESS defaults: argparse copies a subparser's
+    # defaults OVER the parent namespace, so a plain default would
+    # silently discard `debug --remote X <verb>` (flag before the verb)
+    def _remote_arg(p):
+        p.add_argument("--remote", default=argparse.SUPPRESS,
+                       help="name=target[,...]; defaults to the local fleet")
+
+    dsp = bsub.add_parser("state", help="dump agent state (DumpState)")
+    _remote_arg(dsp)
+    dsp.set_defaults(func=cmd_debug)
+
+    frp = bsub.add_parser("flight-record",
+                          help="recent spans/logs/errors per agent "
+                          "(the crash-safe black box)")
+    _remote_arg(frp)
+    frp.add_argument("--node", default=argparse.SUPPRESS,
+                     help="restrict to one node")
+    frp.set_defaults(func=cmd_debug_flight)
+
+    dtp = bsub.add_parser("trace", help="distributed-trace verbs")
+    dtsub = dtp.add_subparsers(dest="trace_verb", required=True)
+    tep = dtsub.add_parser("export", help="merge local + agent spans into "
+                           "Chrome trace-event JSON (Perfetto-loadable)")
+    _remote_arg(tep)
+    tep.add_argument("--node", default=argparse.SUPPRESS,
+                     help="restrict to one node")
+    tep.add_argument("--trace-id", default="",
+                     help="export only this trace (default: all retained)")
+    tep.add_argument("--out", default="ig-trace.json",
+                     help="output path, or '-' for stdout")
+    tep.set_defaults(func=cmd_debug_trace_export)
 
     vp = sub.add_parser("version", help="print version")
     vp.set_defaults(func=lambda a: (print(_version()), 0)[1])
@@ -175,6 +209,7 @@ def cmd_doctor(args) -> int:
     reshaped as an on-demand capability probe (see doctor.py)."""
     from ..doctor import gadget_report, probe_windows, render_report
     from ..telemetry import snapshot
+    from ..utils.platform_probe import last_acquire
     windows = probe_windows()
     gadgets = gadget_report(windows)
     if args.output == "json":
@@ -182,6 +217,8 @@ def cmd_doctor(args) -> int:
         print(json.dumps({
             "windows": {k: dc.asdict(w) for k, w in windows.items()},
             "gadgets": [dc.asdict(g) for g in gadgets],
+            # device-plane acquisition outcome (agents probe at startup)
+            "platform": last_acquire() or {"platform": "unprobed"},
             # the probed facts double as registry gauges; the snapshot ties
             # this report to the same plane bench/agents expose
             "telemetry": snapshot(),
@@ -273,9 +310,8 @@ def cmd_debug(args) -> int:
     """ref: `kubectl-gadget debug` + DumpState RPC
     (gadgettracermanager.go:204-219, cmd/kubectl-gadget/debug.go)."""
     from ..agent.client import AgentClient
-    from .deploy import local_targets
     try:
-        targets = parse_targets(args.remote) if args.remote else local_targets()
+        targets = _debug_targets(args)
     except ParamError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -291,6 +327,86 @@ def cmd_debug(args) -> int:
         except Exception as e:  # noqa: BLE001 — per-node isolation
             print(f"=== {node} ({target}) === error: {e}", file=sys.stderr)
             rc = 1
+    return rc
+
+
+def _debug_targets(args) -> dict[str, str]:
+    """--remote targets, else the local fleet, filtered by --node when
+    set; may be empty (caller decides whether local-process data
+    suffices). Raises ParamError on malformed --remote or unknown
+    --node."""
+    from .deploy import local_targets
+    targets = parse_targets(args.remote) if args.remote else local_targets()
+    node = getattr(args, "node", "")
+    if node:
+        targets = {n: t for n, t in targets.items() if n == node}
+        if not targets:
+            raise ParamError(f"unknown node {node!r}")
+    return targets
+
+
+def cmd_debug_flight(args) -> int:
+    """ref: the flight-recorder analogue of `kubectl-gadget debug` — the
+    agent's crash-safe ring of recent spans/logs/errors over DumpState."""
+    from ..agent.client import AgentClient
+    try:
+        targets = _debug_targets(args)
+    except ParamError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not targets:
+        # no agents: this process's own flight record is still evidence
+        from ..telemetry.tracing import RECORDER
+        print(json.dumps({"local": RECORDER.snapshot()}, indent=2,
+                         default=str))
+        return 0
+    rc = 0
+    out = {}
+    for node, target in targets.items():
+        try:
+            out[node] = AgentClient(target, node_name=node).flight_record()
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            out[node] = {"error": str(e)}
+            rc = 1
+    print(json.dumps(out, indent=2, default=str))
+    return rc
+
+
+def cmd_debug_trace_export(args) -> int:
+    """Merge this process's span ring with every agent's (via DumpState)
+    and write one Chrome trace-event JSON file (Perfetto-loadable)."""
+    from ..agent.client import AgentClient
+    from ..telemetry.tracing import TRACER, export_chrome
+    try:
+        targets = _debug_targets(args)
+    except ParamError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    spans = TRACER.export()
+    rc = 0
+    for node, target in targets.items():
+        try:
+            # pull deep into the agent's span ring, not the 512-span
+            # debug default (a truncated export silently loses early
+            # spans) — but stay under gRPC's 4 MiB default message cap:
+            # ~250 B/span JSON puts 8192 spans around 2 MiB
+            fr = AgentClient(target, node_name=node).flight_record(
+                max_spans=8192)
+            for s in fr.get("spans", []):
+                s.setdefault("node", node)
+                spans.append(s)
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            print(f"{node}: error: {e}", file=sys.stderr)
+            rc = 1
+    doc = export_chrome(spans, trace_id=args.trace_id or None)
+    payload = json.dumps(doc, default=str)
+    if args.out == "-":
+        print(payload)
+    else:
+        with open(args.out, "w") as f:
+            f.write(payload)
+        n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+        print(f"wrote {n_spans} spans to {args.out}")
     return rc
 
 

@@ -27,10 +27,13 @@ Pod dict schema (subset of v1.Pod): {"name", "namespace", "uid", "node",
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from typing import Callable, Iterable
 
 from .container import Container
+
+log = logging.getLogger("ig-tpu.podinformer")
 
 PodSource = Callable[[], Iterable[dict]]
 
@@ -98,14 +101,14 @@ class PodInformer:
             if self.on_add:
                 try:
                     self.on_add(c)
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — one bad callback must not stop the diff
+                    log.warning("pod-informer add callback failed: %r", e)
         for k in removed:
             if self.on_remove:
                 try:
                     self.on_remove(k)
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    log.warning("pod-informer remove callback failed: %r", e)
         return len(added), len(removed)
 
     def start(self) -> None:

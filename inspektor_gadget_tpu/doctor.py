@@ -16,6 +16,7 @@ Probes are cheap, side-effect-free, and never raise: each returns
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import socket
 
@@ -460,8 +461,9 @@ def _gadget_class(desc):
             v = getattr(module, nm, None)
             if isinstance(v, type) and hasattr(v, "native_kind"):
                 return v
-    except Exception:  # noqa: BLE001
-        pass
+    except Exception as e:  # noqa: BLE001
+        logging.getLogger("ig-tpu.doctor").debug(
+            "gadget class extraction failed for %s: %r", desc.name, e)
     return None
 
 
@@ -488,6 +490,17 @@ def render_report(windows: dict[str, Window] | None = None,
     counts: dict[str, int] = {}
     for g in gadgets:
         counts[g.status] = counts.get(g.status, 0) + 1
+    lines.append("")
+    # device-plane acquisition outcome (set by acquire_platform — the
+    # agent probes at startup; standalone doctor shows "unprobed")
+    from .utils.platform_probe import last_acquire
+    acq = last_acquire()
+    if acq is not None:
+        mark = "degraded " if acq["degraded"] else ""
+        lines.append(f"PLATFORM {mark}{acq['platform']} ({acq['detail']})")
+    else:
+        lines.append("PLATFORM unprobed (agents probe at startup; "
+                     "see --platform)")
     lines.append("")
     lines.append("SUMMARY " + "  ".join(
         f"{k}={v}" for k, v in sorted(counts.items())))

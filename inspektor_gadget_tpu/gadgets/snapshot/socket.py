@@ -12,6 +12,7 @@ inode) with container/netns identity; protocol filter param mirrored.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import socket
 import struct
 
@@ -147,8 +148,9 @@ class SnapshotSocket:
             if sel_name:
                 from ...containers import ContainerSelector
                 selector = ContainerSelector(name=sel_name)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — unselected scan still valid
+            logging.getLogger("ig-tpu.snapshot").debug(
+                "container selector parse failed: %r", e)
         rows: list[SocketEvent] = []
         for root, cname, netnsid in _netns_views(selector):
             if self.proto in ("all", "tcp"):

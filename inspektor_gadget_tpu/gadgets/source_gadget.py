@@ -10,6 +10,7 @@ supply the event dataclass + a row decoder + source kind.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable
 
 import numpy as np
@@ -21,6 +22,8 @@ from ..sources.bridge import make_cfg as B_make_cfg
 from ..telemetry import counter, gauge
 from .context import GadgetContext
 from .interface import GadgetDesc
+
+log = logging.getLogger("ig-tpu.source")
 
 # capture-plane telemetry, batch-grain (one lock touch per pop, never per
 # event — the pop loop is the display-path ceiling)
@@ -432,8 +435,8 @@ class SourceTraceGadget:
         stays valid until run teardown / GC closes it."""
         try:
             src.stop()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — retire must not fail the run
+            log.debug("source stop on retire failed: %r", e)
         with self._attach_lock:
             self._retired_sources.append(src)
 
@@ -500,8 +503,8 @@ class SourceTraceGadget:
                 try:
                     src.stop()
                     src.close()
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — teardown best-effort
+                    log.debug("source teardown failed: %r", e)
 
     def _source_done(self) -> bool:
         """True when no source will ever produce again (a ptrace-spawned

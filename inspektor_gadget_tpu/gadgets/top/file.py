@@ -19,6 +19,7 @@ Two windows here:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 
 import numpy as np
@@ -127,8 +128,9 @@ class TopFile(IntervalGadget):
             try:
                 src.stop()
                 src.close()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                logging.getLogger("ig-tpu.top-file").debug(
+                    "source teardown failed: %r", e)
         self._src = None
 
     # per-container mount marks (same role as trace/open's

@@ -397,8 +397,9 @@ class TraceWatcher:
             while not self._stop.is_set():
                 try:
                     self.poll_once()
-                except Exception:  # noqa: BLE001 — the loop must survive
-                    pass
+                except Exception as e:  # noqa: BLE001 — the loop must survive
+                    logging.getLogger("ig-tpu.tracewatcher").debug(
+                        "poll failed: %r", e)
                 self._stop.wait(self.interval)
 
         self._thread = threading.Thread(target=loop, daemon=True,

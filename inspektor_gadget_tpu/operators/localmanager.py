@@ -150,8 +150,8 @@ class LocalManagerInstance(OperatorInstance):
             for c in self._attached:
                 try:
                     self.gadget.detach_container(c)
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — detach the rest
+                    self.ctx.logger.debug("detach on teardown failed: %r", e)
             self._attached.clear()
 
     def _attach_enabled(self) -> bool:
@@ -187,8 +187,8 @@ class LocalManagerInstance(OperatorInstance):
             else:
                 try:
                     self.gadget.detach_container(ev.container)
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — container already gone
+                    self.ctx.logger.debug("detach failed: %r", e)
 
     def enrich(self, event: Any) -> None:
         if self.op.cc is not None:

@@ -21,6 +21,7 @@ from ..gadgets.context import GadgetContext
 from ..gadgets.interface import GadgetDesc
 from ..params import Collection, ParamDescs, Params
 from ..telemetry import counter, histogram
+from ..telemetry.tracing import TRACER
 
 # chain telemetry: batch-grain only (the per-event enrich() path stays
 # uninstrumented — at millions of rows/sec even a perf_counter pair would
@@ -109,13 +110,20 @@ class Operators(list):
         return spans
 
     def enrich_batch(self, batch: Any) -> Any:
+        # batch-grain child spans (parented to the run span) upgrade the
+        # bare histogram timers: the histogram keeps the aggregate, the
+        # span places THIS batch's enrich on the run's timeline
+        parent = getattr(self, "trace_parent", None)
+        n = batch.count
         for inst, hist in self._spans():
-            t0 = time.perf_counter()
-            inst.enrich_batch(batch)
-            hist.observe(time.perf_counter() - t0)
+            with TRACER.span(f"op/{inst.name}", parent=parent,
+                             attrs={"events": n}):
+                t0 = time.perf_counter()
+                inst.enrich_batch(batch)
+                hist.observe(time.perf_counter() - t0)
         events = getattr(self, "gadget_events", None)
-        if events is not None and batch.count:
-            events.inc(batch.count)
+        if events is not None and n:
+            events.inc(n)
         return batch
 
 
@@ -235,6 +243,10 @@ def install_operators(
     ops = operators if operators is not None else get_operators_for_gadget(ctx.desc)
     instances = Operators()
     instances.gadget_events = _gadget_events.labels(gadget=ctx.desc.full_name)
+    # the run span context (set by the runtime before install): enrich
+    # spans parent to it even from source/drain threads, where the
+    # tracer's contextvar is empty
+    instances.trace_parent = ctx.extra.get("trace_ctx")
     for op in ops:
         with _init_lock:
             if op.name not in _initialized:

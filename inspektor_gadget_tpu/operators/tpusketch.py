@@ -35,6 +35,7 @@ from ..ops.sketches import bundle_digest_jit, bundle_update_jit, decode_digest
 from ..params import ParamDesc, ParamDescs, Params, TypeHint
 from ..sources.batch import EventBatch
 from ..telemetry import counter, histogram
+from ..telemetry.tracing import TRACER, device_annotation
 from ..utils.logger import get_logger
 from .operators import Operator, OperatorInstance, register
 
@@ -194,6 +195,9 @@ class TpuSketchInstance(OperatorInstance):
         self.distinct_col = p.get("distinct-column").as_string()
         self.dist_col = p.get("dist-column").as_string()
         self.harvest_interval = p.get("harvest-interval").as_duration() or 1.0
+        # device-plane spans parent to the run span; the checkpointer and
+        # post_gadget_run threads have no ambient span, so keep it pinned
+        self._trace_parent = ctx.extra.get("trace_ctx")
         # serializes bundle read/update: bundle_update_jit DONATES its
         # input, so the checkpointer thread reading self.bundle while the
         # run thread dispatches an update would read deleted buffers
@@ -262,6 +266,13 @@ class TpuSketchInstance(OperatorInstance):
         with _live_mu:
             _live[ctx.run_id] = self
 
+    def _span(self, name: str, **attrs):
+        """Device-plane span: nests under the enrich span when called from
+        the operator chain (ambient current), else under the run span."""
+        cur = TRACER.current_context()
+        return TRACER.span(name, parent=cur if cur is not None
+                           else self._trace_parent, attrs=attrs)
+
     # the columnar hot path -------------------------------------------------
 
     def enrich_batch(self, batch: EventBatch) -> None:
@@ -283,22 +294,25 @@ class TpuSketchInstance(OperatorInstance):
             return out
 
         t0 = time.perf_counter()
-        hh = keys_for(self.hh_col)
-        distinct = hh if self.distinct_col == self.hh_col else keys_for(self.distinct_col)
-        dist = hh if self.dist_col == self.hh_col else keys_for(self.dist_col)
-        mask = np.zeros(pad, dtype=bool)
-        mask[:n] = True
-        new_drops = batch.drops - self._drops_seen
-        self._drops_seen = batch.drops
-        hh_d, distinct_d, dist_d, mask_d = (
-            jnp.asarray(hh), jnp.asarray(distinct), jnp.asarray(dist),
-            jnp.asarray(mask))
+        with self._span("tpusketch/h2d", events=n, pad=pad):
+            hh = keys_for(self.hh_col)
+            distinct = hh if self.distinct_col == self.hh_col else keys_for(self.distinct_col)
+            dist = hh if self.dist_col == self.hh_col else keys_for(self.dist_col)
+            mask = np.zeros(pad, dtype=bool)
+            mask[:n] = True
+            new_drops = batch.drops - self._drops_seen
+            self._drops_seen = batch.drops
+            hh_d, distinct_d, dist_d, mask_d = (
+                jnp.asarray(hh), jnp.asarray(distinct), jnp.asarray(dist),
+                jnp.asarray(mask))
         t1 = time.perf_counter()
-        with self._bundle_mu:
-            self.bundle = bundle_update_jit(
-                self.bundle, hh_d, distinct_d, dist_d, mask_d,
-                jnp.float32(max(new_drops, 0)),
-            )
+        with self._span("tpusketch/update", events=n), \
+                device_annotation("ig:tpusketch_update"):
+            with self._bundle_mu:
+                self.bundle = bundle_update_jit(
+                    self.bundle, hh_d, distinct_d, dist_d, mask_d,
+                    jnp.float32(max(new_drops, 0)),
+                )
         t2 = time.perf_counter()
         self._m_h2d.observe(t1 - t0)
         self._m_update.observe(t2 - t1)
@@ -375,6 +389,11 @@ class TpuSketchInstance(OperatorInstance):
     # harvest ---------------------------------------------------------------
 
     def harvest(self) -> SketchSummary:
+        with self._span("tpusketch/harvest", epoch=self._epoch + 1), \
+                device_annotation("ig:tpusketch_harvest"):
+            return self._harvest_traced()
+
+    def _harvest_traced(self) -> SketchSummary:
         t0 = time.perf_counter()
         # one packed digest: a single D2H transfer per tick, not 6 (each
         # read through the tunnel is tens of ms); dispatched under the
@@ -442,19 +461,22 @@ class TpuSketchInstance(OperatorInstance):
         base = _ckpt_dir / self._ckpt_key
         # broad catch: any unreadable checkpoint (missing, config mismatch,
         # torn zip — np.load raises BadZipFile, not OSError) means fresh
-        # state, never a refusal to start
+        # state, never a refusal to start — but say so, don't eat it
         try:
-            prior = load_pytree(base, like=self.bundle)
-            with _tm_merge_s.time():
-                self.bundle = bundle_merge(self.bundle, prior)
-        except Exception:  # noqa: BLE001
-            pass
+            with self._span("tpusketch/resume"):
+                prior = load_pytree(base, like=self.bundle)
+                with _tm_merge_s.time():
+                    self.bundle = bundle_merge(self.bundle, prior)
+        except Exception as e:  # noqa: BLE001
+            _ckpt_log.debug("resume of %s skipped (fresh state): %r",
+                            self._ckpt_key, e)
         if self.scorer is not None:
             try:
                 self.scorer = load_pytree(
                     Path(str(base) + "-scorer"), like=self.scorer)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                _ckpt_log.debug("scorer resume of %s skipped: %r",
+                                self._ckpt_key, e)
 
     def checkpoint(self) -> None:
         """Host-offload + save current state. Two concurrent runs of the
@@ -472,13 +494,15 @@ class TpuSketchInstance(OperatorInstance):
 
         from ..utils.checkpoint import save_pytree
         base = _ckpt_dir / self._ckpt_key
-        with self._bundle_mu:
-            bundle_host = jax.tree.map(np.asarray, self.bundle)
-            scorer_host = (jax.tree.map(np.asarray, self.scorer)
-                           if self.scorer is not None else None)
-        save_pytree(base, bundle_host)
-        if scorer_host is not None:
-            save_pytree(Path(str(base) + "-scorer"), scorer_host)
+        with self._span("tpusketch/checkpoint", key=self._ckpt_key), \
+                device_annotation("ig:tpusketch_checkpoint"):
+            with self._bundle_mu:
+                bundle_host = jax.tree.map(np.asarray, self.bundle)
+                scorer_host = (jax.tree.map(np.asarray, self.scorer)
+                               if self.scorer is not None else None)
+            save_pytree(base, bundle_host)
+            if scorer_host is not None:
+                save_pytree(Path(str(base) + "-scorer"), scorer_host)
 
     # display helpers -------------------------------------------------------
 

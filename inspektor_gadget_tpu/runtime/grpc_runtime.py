@@ -23,6 +23,7 @@ from ..gadgets.interface import GadgetType
 from ..params import ParamDesc, ParamDescs
 from ..snapshotcombiner import SnapshotCombiner
 from ..telemetry import counter, gauge
+from ..telemetry.tracing import TRACER
 from .runtime import CombinedGadgetResult, GadgetResult, Runtime
 
 STOP_RESULT_TIMEOUT = 30.0  # ref: grpc-runtime.go:347-353
@@ -91,6 +92,26 @@ class GrpcRuntime(Runtime):
         on_event_array: Callable[[list], None] | None = None,
         on_batch: Callable[[Any], None] | None = None,
         on_summary: Callable[[str, dict], None] | None = None,
+    ) -> CombinedGadgetResult:
+        # the client runtime mints the trace: one trace ID per gadget run,
+        # propagated through every node's RunGadget request so client,
+        # agent, operator, and device spans stitch into a single timeline
+        with TRACER.span(f"client/run/{ctx.desc.full_name}",
+                         parent=ctx.extra.get("trace_ctx"),
+                         attrs={"run_id": ctx.run_id,
+                                "gadget": ctx.desc.full_name}) as root:
+            ctx.extra["trace_ctx"] = root.context
+            return self._run_fanout(ctx, root, on_event, on_event_array,
+                                    on_batch, on_summary)
+
+    def _run_fanout(
+        self,
+        ctx: GadgetContext,
+        root_span,
+        on_event: Callable[[Any], None] | None,
+        on_event_array: Callable[[list], None] | None,
+        on_batch: Callable[[Any], None] | None,
+        on_summary: Callable[[str, dict], None] | None,
     ) -> CombinedGadgetResult:
         node_filter = ""
         if "node" in ctx.runtime_params:
@@ -166,32 +187,46 @@ class GrpcRuntime(Runtime):
             elif on_event_array is not None:
                 on_event_array(evs)
 
+        def on_remote_log(n: str, sev: int, msg: str, header: dict):
+            # remote run/trace IDs ride the record as attrs, so the
+            # flight recorder can correlate the line with its spans
+            from ..utils.logger import std_from_severity
+            ctx.logger.log(std_from_severity(sev), "[%s] %s", n, msg,
+                           extra={"run_id": header.get("run_id", ""),
+                                  "trace_id": header.get("trace_id", "")})
+
         def run_node(node: str):
-            client = self._client(node)
-            try:
-                res = client.run_gadget(
-                    ctx.desc.category, ctx.desc.name, flat,
-                    timeout=ctx.timeout, outputs=tuple(outputs),
-                    on_json=on_json, on_array=on_array,
-                    on_batch=(lambda n, b: on_batch(b)) if on_batch else None,
-                    on_summary=on_summary,
-                    on_log=lambda n, sev, msg: ctx.logger.log(
-                        max(10, 50 - sev * 10), "[%s] %s", n, msg),
-                    stop_event=stop_event,
-                )
-                with results_mu:
-                    results[node] = GadgetResult(result=res.get("result"),
-                                                 error=res.get("error"))
-                    if res.get("error"):
-                        _tm_node_errors.labels(node=node).inc()
-                    if res.get("gaps"):
-                        _tm_node_gaps.labels(node=node).inc(res["gaps"])
-                        ctx.logger.warning("[%s] %d events lost in transit",
-                                           node, res["gaps"])
-            except Exception as e:  # per-node isolation (runtime.go:42-79)
-                _tm_node_errors.labels(node=node).inc()
-                with results_mu:
-                    results[node] = GadgetResult(error=str(e))
+            # one child span per node stream; its context rides the run
+            # request so the agent's server spans parent to it
+            with TRACER.span(f"client/node/{node}",
+                             parent=root_span.context,
+                             attrs={"node": node}) as nsp:
+                client = self._client(node)
+                try:
+                    res = client.run_gadget(
+                        ctx.desc.category, ctx.desc.name, flat,
+                        timeout=ctx.timeout, outputs=tuple(outputs),
+                        on_json=on_json, on_array=on_array,
+                        on_batch=(lambda n, b: on_batch(b)) if on_batch else None,
+                        on_summary=on_summary,
+                        on_log=on_remote_log,
+                        stop_event=stop_event,
+                        trace_ctx=nsp.context,
+                    )
+                    with results_mu:
+                        results[node] = GadgetResult(result=res.get("result"),
+                                                     error=res.get("error"))
+                        if res.get("error"):
+                            _tm_node_errors.labels(node=node).inc()
+                        if res.get("gaps"):
+                            _tm_node_gaps.labels(node=node).inc(res["gaps"])
+                            ctx.logger.warning("[%s] %d events lost in transit",
+                                               node, res["gaps"])
+                except Exception as e:  # per-node isolation (runtime.go:42-79)
+                    nsp.set_attr("error", str(e))
+                    _tm_node_errors.labels(node=node).inc()
+                    with results_mu:
+                        results[node] = GadgetResult(error=str(e))
 
         threads = [threading.Thread(target=run_node, args=(n,), daemon=True)
                    for n in nodes]

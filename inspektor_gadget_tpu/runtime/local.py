@@ -51,6 +51,18 @@ class LocalRuntime(Runtime):
         return result
 
     def _run(self, ctx, on_event, on_event_array, on_batch):
+        from ..telemetry.tracing import TRACER
+        # one span per local run: child of the agent's run span when this
+        # runtime serves a gRPC request (ctx.extra carries the context),
+        # a fresh trace for a standalone `ig-tpu <gadget>` run
+        with TRACER.span(f"run/{ctx.desc.full_name}",
+                         parent=ctx.extra.get("trace_ctx"),
+                         attrs={"run_id": ctx.run_id,
+                                "node": self.node_name}) as span:
+            ctx.extra["trace_ctx"] = span.context
+            return self._run_traced(ctx, on_event, on_event_array, on_batch)
+
+    def _run_traced(self, ctx, on_event, on_event_array, on_batch):
         gadget = ctx.desc.new_instance(ctx)
         instances = install_operators(ctx, gadget, ctx.operator_params)
 

@@ -345,7 +345,7 @@ class NativeCapture:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow-silent-except — logging is unsafe during interpreter shutdown
             pass
 
     def pop(self) -> EventBatch:

@@ -28,3 +28,16 @@ from .registry import (  # noqa: F401
     snapshot,
 )
 from .http import MetricsServer, parse_addr  # noqa: F401
+from .tracing import (  # noqa: F401
+    RECORDER,
+    TRACER,
+    FlightRecorder,
+    FlightRecorderHandler,
+    SpanContext,
+    SpanRecord,
+    Tracer,
+    device_annotation,
+    export_chrome,
+    install_crash_handlers,
+    parse_traceparent,
+)

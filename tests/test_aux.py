@@ -101,10 +101,11 @@ def test_stream_logger_severity_encoding():
     from inspektor_gadget_tpu.utils.logger import WARN, StreamLogger
 
     pushed = []
-    sl = StreamLogger(lambda t, payload: pushed.append((t, payload)))
+    sl = StreamLogger(lambda t, hdr, payload: pushed.append((t, hdr, payload)))
     sl.warn("careful")
-    t, payload = pushed[0]
+    t, hdr, payload = pushed[0]
     assert t >> 16 == WARN
+    assert hdr == {}  # no run/trace identity configured
     assert payload == b"careful"
 
 

@@ -1,0 +1,54 @@
+"""Tier-1 lint: no silently-swallowed broad excepts in the package (the
+`except Exception: pass` pattern that ate checkpoint failures in round
+5), plus self-tests that the checker actually catches the pattern."""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from tools.check_bare_except import check_paths, check_source
+
+PKG = Path(__file__).resolve().parent.parent / "inspektor_gadget_tpu"
+
+
+def test_package_has_no_silent_broad_excepts():
+    violations = check_paths(PKG)
+    assert not violations, "\n".join(violations)
+
+
+def test_checker_flags_the_round5_pattern():
+    bad = textwrap.dedent("""
+        try:
+            risky()
+        except Exception:
+            pass
+    """)
+    (v,) = check_source(bad, "bad.py")
+    assert "bad.py:4" in v and "swallowed" in v
+
+
+def test_checker_flags_bare_and_tuple_and_ellipsis():
+    assert check_source("try:\n x()\nexcept:\n pass\n", "f.py")
+    assert check_source(
+        "try:\n x()\nexcept (ValueError, Exception):\n pass\n", "f.py")
+    assert check_source("try:\n x()\nexcept Exception:\n ...\n", "f.py")
+
+
+def test_checker_allows_narrow_and_handled_and_waived():
+    # narrow type: documents exactly what is ignored
+    assert not check_source(
+        "try:\n x()\nexcept OSError:\n pass\n", "f.py")
+    # broad but handled: fine
+    assert not check_source(
+        "try:\n x()\nexcept Exception as e:\n log(e)\n", "f.py")
+    # explicit waiver with a reason of record
+    assert not check_source(
+        "try:\n x()\n"
+        "except Exception:  # lint: allow-silent-except — shutdown\n"
+        " pass\n", "f.py")
+
+
+def test_checker_reports_unparseable_files():
+    (v,) = check_source("def broken(:\n", "oops.py")
+    assert "unparseable" in v

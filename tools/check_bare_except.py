@@ -1,0 +1,96 @@
+#!/usr/bin/env python3
+"""Lint: forbid silently-swallowed broad excepts in inspektor_gadget_tpu/.
+
+The round-5 VERDICT traced silently-eaten checkpoint failures to the
+`except Exception: pass` pattern; this check makes the pattern a test
+failure instead of a code-review hope. A handler violates when BOTH:
+
+  * it catches broadly — bare ``except:``, ``Exception`` or
+    ``BaseException`` (alone or inside a tuple), and
+  * its body does nothing — only ``pass`` / ``...`` statements.
+
+Narrow catches (``except OSError: pass``) stay legal: they document
+exactly which failure is being ignored. A genuinely-unloggable site
+(e.g. ``__del__`` during interpreter shutdown) can waive the check with
+an ``# lint: allow-silent-except — <reason>`` comment on the except
+line; the waiver text is the reason of record.
+
+Run standalone (``python tools/check_bare_except.py [root]``, exit 1 on
+violations) or through the tier-1 suite (tests/test_lint.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+WAIVER = "allow-silent-except"
+BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(type_node: ast.expr | None) -> bool:
+    if type_node is None:  # bare except:
+        return True
+    if isinstance(type_node, ast.Name):
+        return type_node.id in BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    return all(
+        isinstance(s, ast.Pass)
+        or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant)
+            and s.value.value is Ellipsis)
+        for s in body
+    )
+
+
+def check_source(src: str, path: str = "<string>") -> list[str]:
+    """Return 'path:line: message' violation strings for one source."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: unparseable: {e.msg}"]
+    lines = src.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (_is_broad(node.type) and _is_silent(node.body)):
+            continue
+        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+        if WAIVER in line:
+            continue
+        out.append(
+            f"{path}:{node.lineno}: silently swallowed broad except — "
+            f"log it, narrow it, or waive with '# lint: {WAIVER} — <why>'")
+    return out
+
+
+def check_paths(root: str | pathlib.Path) -> list[str]:
+    root = pathlib.Path(root)
+    files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+    out: list[str] = []
+    for f in files:
+        out.extend(check_source(f.read_text(encoding="utf-8"), str(f)))
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else str(
+        pathlib.Path(__file__).resolve().parent.parent / "inspektor_gadget_tpu")
+    violations = check_paths(root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
