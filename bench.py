@@ -60,6 +60,10 @@ SHAPES = {
 }
 
 PROBE_TIMEOUT_S = int(os.environ.get("IG_BENCH_PROBE_TIMEOUT", "90"))
+# one tunnel blip must not cost the round's number (VERDICT next-round
+# #2): the probe gets N attempts with backoff spread over a horizon
+PROBE_ATTEMPTS = max(int(os.environ.get("IG_BENCH_PROBE_ATTEMPTS", "3")), 1)
+PROBE_HORIZON_S = float(os.environ.get("IG_BENCH_PROBE_HORIZON", "120"))
 TPU_CHILD_TIMEOUT_S = int(os.environ.get("IG_BENCH_TPU_TIMEOUT", "360"))
 CPU_CHILD_TIMEOUT_S = int(os.environ.get("IG_BENCH_CPU_TIMEOUT", "240"))
 
@@ -254,7 +258,35 @@ def _spawn(args: list[str], timeout: float) -> tuple[dict | None, str]:
     return None, "no JSON line in child output"
 
 
-def main(forced: str | None = None) -> None:
+def _probe_with_retry() -> tuple[dict | None, str, list[dict]]:
+    """Probe the backend up to PROBE_ATTEMPTS times, sleeps between
+    attempts spread exponentially over PROBE_HORIZON_S. Only a probe
+    FAILURE (timeout/crash) is retried — an answer, tpu or cpu, is
+    authoritative. Returns (probe-json-or-None, last-error, trail); the
+    trail lands in the record so the acquisition story is data."""
+    # lazy import: pure-python module, keeps the never-touch-jax contract
+    from inspektor_gadget_tpu.utils.platform_probe import backoff_gaps
+    gaps = backoff_gaps(PROBE_ATTEMPTS, PROBE_HORIZON_S)
+    trail: list[dict] = []
+    perr = ""
+    for i in range(PROBE_ATTEMPTS):
+        t0 = time.perf_counter()
+        probe, perr = _spawn(["--probe"], PROBE_TIMEOUT_S)
+        trail.append({"attempt": i + 1,
+                      "ok": bool(probe and probe.get("ok")),
+                      "platform": (probe or {}).get("platform", ""),
+                      "error": perr,
+                      "elapsed_s": round(time.perf_counter() - t0, 2)})
+        if probe and probe.get("ok"):
+            return probe, "", trail
+        if i < PROBE_ATTEMPTS - 1:
+            print(f"probe attempt {i + 1}/{PROBE_ATTEMPTS} failed "
+                  f"({perr}); retrying in {gaps[i]:.0f}s", file=sys.stderr)
+            time.sleep(gaps[i])
+    return None, perr, trail
+
+
+def main(forced: str | None = None, ledger: str | None = None) -> None:
     extra: dict = {"pipeline":
                    "gen(C++)->fold32->H2D->bundle_update, depth-4 queue"}
     try:
@@ -267,12 +299,13 @@ def main(forced: str | None = None) -> None:
     forced = forced or os.environ.get("IG_BENCH_PLATFORM")
     result = None
     errors = {}
+    probe_trail: list[dict] = []
     if forced == "tpu":
         result, terr = _spawn(["--child", "tpu"], TPU_CHILD_TIMEOUT_S)
         if result is None:
             errors["tpu"] = terr
     elif forced != "cpu":
-        probe, perr = _spawn(["--probe"], PROBE_TIMEOUT_S)
+        probe, perr, probe_trail = _probe_with_retry()
         # a probe that resolves to the CPU backend means there is no
         # accelerator — running the production shapes there would burn the
         # whole timeout (or mislabel a CPU run as tpu), so skip to fallback
@@ -305,6 +338,8 @@ def main(forced: str | None = None) -> None:
         extra["degraded"] = True
     if errors:
         extra["error"] = errors
+    if probe_trail:
+        extra["probe_attempts"] = probe_trail
 
     # telemetry snapshot: the platform/degraded facts become registry
     # gauges and the record carries real pipeline counters (the child's
@@ -323,13 +358,51 @@ def main(forced: str | None = None) -> None:
     child_tel = result.pop("telemetry", {}) if result else {}
     extra["telemetry"] = {**child_tel, **snapshot()}
 
-    print(json.dumps({
+    record = {
         "metric": "sketch_ingest_throughput_e2e",
         "value": value,
         "unit": "events/sec/chip",
         "vs_baseline": round(value / BASELINE_EV_S, 3),
         "extra": extra,
-    }))
+    }
+    print(json.dumps(record))
+
+    # the headline also lands in the append-only perf ledger as a
+    # provenance-stamped PerfRecord (--ledger PATH / $IG_BENCH_LEDGER;
+    # the one-JSON-line + exit-0 contract above is never at risk)
+    ledger = ledger or os.environ.get("IG_BENCH_LEDGER")
+    if ledger:
+        try:
+            _append_ledger(record, probe_trail, errors, ledger)
+        except Exception as e:  # noqa: BLE001
+            print(f"ledger append failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+
+def _append_ledger(record: dict, probe_trail: list[dict], errors: dict,
+                   path: str) -> None:
+    from inspektor_gadget_tpu.perf import append_record, make_record
+    from inspektor_gadget_tpu.perf.provenance import build_provenance
+    extra = record["extra"]
+    stages: dict = {}
+    if isinstance(extra.get("host_plane_ev_per_s"), (int, float)):
+        stages["pop"] = {"ev_per_s": extra["host_plane_ev_per_s"]}
+    if isinstance(extra.get("device_plane_ev_per_s"), (int, float)):
+        stages["bundle_update"] = {"ev_per_s": extra["device_plane_ev_per_s"]}
+    if isinstance(extra.get("merge_ms_p50"), (int, float)):
+        stages["merge"] = {"ms_p50": extra["merge_ms_p50"]}
+    outcome = "ok" if not extra["degraded"] else "degraded"
+    probe = {"outcome": outcome, "attempts": probe_trail}
+    if errors:
+        probe["detail"] = "; ".join(f"{k}: {v}" for k, v in errors.items())
+    prov = build_provenance(extra["platform"], extra["degraded"], probe)
+    rec = make_record(
+        config="bench.e2e", metric=record["metric"], unit=record["unit"],
+        value=record["value"], stages=stages, provenance=prov,
+        telemetry=extra.get("telemetry"),
+        extra={"batch": extra.get("batch", 0),
+               "vs_baseline": record["vs_baseline"]})
+    append_record(rec, path)
 
 
 if __name__ == "__main__":
@@ -342,13 +415,21 @@ if __name__ == "__main__":
         print(json.dumps(run_child(sys.argv[2])))
     else:
         forced_arg = None
+        ledger_arg = None
         if "--platform" in sys.argv:
             i = sys.argv.index("--platform")
             forced_arg = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
             if forced_arg not in ("auto", "tpu", "cpu"):
-                print("usage: bench.py [--platform auto|tpu|cpu]",
-                      file=sys.stderr)
+                print("usage: bench.py [--platform auto|tpu|cpu] "
+                      "[--ledger PATH]", file=sys.stderr)
                 sys.exit(2)
             if forced_arg == "auto":
                 forced_arg = None
-        main(forced_arg)
+        if "--ledger" in sys.argv:
+            i = sys.argv.index("--ledger")
+            if i + 1 >= len(sys.argv):
+                print("usage: bench.py [--platform auto|tpu|cpu] "
+                      "[--ledger PATH]", file=sys.stderr)
+                sys.exit(2)
+            ledger_arg = sys.argv[i + 1]
+        main(forced_arg, ledger_arg)

@@ -25,10 +25,17 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
 import time
 
 import numpy as np
+
+DISPLAY_TARGET_EV_S = 5_000_000
+
+
+class DisplayPathRegression(AssertionError):
+    """Config 1d below its ≥5M ev/s floor — a gate failure, not a report."""
 
 
 def _emit(rec: dict) -> None:
@@ -199,17 +206,31 @@ def config1d_display_path(seconds: float) -> dict:
     # the FILTERED path (filters pushed down columnar, survivors only);
     # both land in the record so neither masquerades as the other.
     rate_all, shown_all = run_display("")
-    return {"config": "1d", "name": "trace-exec-display-path",
-            "metric": "display_ingest_ev_per_s", "unit": "events/sec",
-            "value": round(min(rate_comm, rate_pid), 1),
-            "extra": {"comm_filter_ev_per_s": round(rate_comm, 1),
-                      "numeric_filter_ev_per_s": round(rate_pid, 1),
-                      "unfiltered_ev_per_s": round(rate_all, 1),
-                      "rows_shown_comm": shown_comm,
-                      "rows_shown_unfiltered": shown_all,
-                      "note": "value/target are the filtered display path; "
-                              "unfiltered_ev_per_s formats every row",
-                      "target": 5_000_000}}
+    value = round(min(rate_comm, rate_pid), 1)
+    rec = {"config": "1d", "name": "trace-exec-display-path",
+           "metric": "display_ingest_ev_per_s", "unit": "events/sec",
+           "value": value,
+           "extra": {"comm_filter_ev_per_s": round(rate_comm, 1),
+                     "numeric_filter_ev_per_s": round(rate_pid, 1),
+                     "unfiltered_ev_per_s": round(rate_all, 1),
+                     "rows_shown_comm": shown_comm,
+                     "rows_shown_unfiltered": shown_all,
+                     "note": "value/target are the filtered display path; "
+                             "unfiltered_ev_per_s formats every row",
+                     "target": DISPLAY_TARGET_EV_S}}
+    # GUARDRAIL (VERDICT Weak #5): the ≥5M filtered-path claim is a
+    # gate, not a report — a run below target must FAIL the config (and
+    # the process exit, see main) instead of quietly emitting a low
+    # number for a human to overlook. IG_BENCH_NO_GATE=1 demotes the
+    # gate to a report for exploratory runs on slow hosts.
+    if (value < DISPLAY_TARGET_EV_S
+            and os.environ.get("IG_BENCH_NO_GATE", "") != "1"):
+        raise DisplayPathRegression(
+            f"config 1d filtered display path {value:,.0f} ev/s is below "
+            f"the {DISPLAY_TARGET_EV_S:,} ev/s target "
+            f"(comm={rate_comm:,.0f}, pid={rate_pid:,.0f}); "
+            f"record: {json.dumps(rec)}")
+    return rec
 
 
 # ---------------------------------------------------------------------------
@@ -467,11 +488,17 @@ def main(argv=None) -> int:
                ("1d", config1d_display_path),
                ("5b", config5b_concurrent_exec_tcp)]
     out = []
+    failed = False
     for key, fn in runners:
         if key not in wanted:
             continue
         try:
             rec = fn(args.seconds)
+        except DisplayPathRegression as e:
+            # a tripped guardrail is a FAILURE of the run, not just a
+            # record: the error is emitted AND the exit code goes nonzero
+            rec = {"config": key, "error": str(e), "gate_failed": True}
+            failed = True
         except Exception as e:  # noqa: BLE001 — a config must not kill the rest
             rec = {"config": key, "error": repr(e)}
         rec["platform"] = platform
@@ -479,7 +506,7 @@ def main(argv=None) -> int:
         time.sleep(0.5)  # let producer threads drain between configs
     for rec in sorted(out, key=lambda r: str(r["config"])):
         _emit(rec)
-    return 0
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

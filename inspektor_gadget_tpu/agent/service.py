@@ -41,6 +41,29 @@ EVENT_BUFFER = 1024  # ref: service.go:134 bounded buffer, drop-on-full
 
 log = logging.getLogger("ig-tpu.agent")
 
+
+def handlers_for(gadget_type, outputs, on_event, on_event_array):
+    """Gadget type → stream handler wiring for a RunGadget stream.
+
+    Raises ValueError for a type this agent does not know how to serve:
+    before this existed, an unknown type silently got no handlers and
+    the client watched an empty stream end cleanly (VERDICT Weak #7 —
+    the advise/traceloop mislabel rode exactly that hole)."""
+    if gadget_type == GadgetType.TRACE:
+        return on_event, None
+    if gadget_type == GadgetType.TRACE_INTERVALS:
+        return None, on_event_array
+    if gadget_type == GadgetType.ONE_SHOT:
+        return None, (on_event_array if "combiner" in outputs else None)
+    if gadget_type in (GadgetType.PROFILE, GadgetType.START_STOP):
+        # run-with-result gadgets: the final rendered bytes ride the
+        # stream as EV_RESULT; no per-event handlers exist to wire
+        return None, None
+    raise ValueError(
+        f"agent has no handler wiring for gadget type {gadget_type!r} "
+        f"(outputs={sorted(outputs)}): refusing to serve a stream that "
+        f"would silently carry no events")
+
 # per-stream RPC telemetry (one lock touch per message, never per event —
 # a message carries a whole batch/array)
 _tm_rpc = counter("ig_agent_rpc_total", "agent RPCs served", ("method",))
@@ -268,17 +291,25 @@ class AgentServer:
 
         threading.Thread(target=control_loop, daemon=True).start()
 
+        # resolve handler wiring BEFORE spawning the run thread so an
+        # unknown gadget type fails the RPC loudly instead of vanishing
+        # inside a daemon thread
+        try:
+            h_event, h_array = handlers_for(desc.gadget_type, outputs,
+                                            on_event, on_event_array)
+        except ValueError as e:
+            log.error("RunGadget %s: %s", desc.full_name, e)
+            yield wire.encode_msg({"type": wire.EV_RESULT, "error": str(e)})
+            return
+
         result_holder = {}
 
         def run_thread():
             try:
                 res = self.runtime.run_gadget(
                     ctx,
-                    on_event=on_event if desc.gadget_type == GadgetType.TRACE else None,
-                    on_event_array=on_event_array
-                    if (desc.gadget_type == GadgetType.TRACE_INTERVALS
-                        or (desc.gadget_type == GadgetType.ONE_SHOT
-                            and "combiner" in outputs)) else None,
+                    on_event=h_event,
+                    on_event_array=h_array,
                     on_batch=on_batch,
                 )
                 result_holder["result"] = res

@@ -127,6 +127,10 @@ def build_parser() -> argparse.ArgumentParser:
                      help="output path, or '-' for stdout")
     tep.set_defaults(func=cmd_debug_trace_export)
 
+    # perf-observability plane: harness runs, ledger, regression gates
+    from .bench import add_bench_parser
+    add_bench_parser(sub)
+
     vp = sub.add_parser("version", help="print version")
     vp.set_defaults(func=lambda a: (print(_version()), 0)[1])
 

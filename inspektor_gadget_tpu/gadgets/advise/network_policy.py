@@ -136,7 +136,9 @@ class AdviseNetworkPolicy(SourceTraceGadget):
 class AdviseNetworkPolicyDesc(GadgetDesc):
     name = "network-policy"
     category = "advise"
-    gadget_type = GadgetType.PROFILE
+    # legacy CRD-path gadget (start..stop→generate), mislabeled PROFILE
+    # until VERDICT Weak #7
+    gadget_type = GadgetType.START_STOP
     description = "Record flows and generate NetworkPolicies"
     event_cls = None
 

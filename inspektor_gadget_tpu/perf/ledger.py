@@ -1,0 +1,176 @@
+"""Append-only perf ledger: benchmarks/ledger/PERF.jsonl.
+
+The machine-written perf history the docs cannot drift from (the role of
+inspektor-gadget's CI benchmark dashboard, kept in-tree): one JSON line
+per PerfRecord, appended atomically, never rewritten. `ig-tpu bench
+compare` baselines against it; `tools/check_perf_claims.py` checks doc
+numbers against it.
+
+Append discipline: the record is validated first (a ledger line that
+fails the schema is worse than no line), serialized to ONE compact line,
+and written on an O_APPEND fd — normally one `os.write`, which POSIX
+makes atomic between processes, so concurrent bench runs cannot
+interleave bytes (a rare short write is completed in a loop or raised,
+never reported as success). Reads tolerate a crash-truncated final line
+(counted, skipped) — the flight-recorder stance applied to perf history.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Iterable
+
+from .schema import SCHEMA_ID, make_record, validate_record
+
+DEFAULT_LEDGER = os.path.join("benchmarks", "ledger", "PERF.jsonl")
+
+
+def ledger_path(path: str | None = None) -> str:
+    return path or os.environ.get("IG_PERF_LEDGER", DEFAULT_LEDGER)
+
+
+@dataclasses.dataclass
+class LedgerRead:
+    records: list[dict]
+    skipped: list[str]          # 'line N: why' for unusable lines
+
+
+def append_record(rec: dict, path: str | None = None) -> str:
+    """Validate + atomically append one record; returns the path used."""
+    errors = validate_record(rec)
+    if errors:
+        raise ValueError("refusing to append invalid PerfRecord: "
+                         + "; ".join(errors))
+    p = ledger_path(path)
+    d = os.path.dirname(p)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    line = json.dumps(rec, sort_keys=True, separators=(",", ":")) + "\n"
+    buf = line.encode("utf-8")
+    fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        while buf:  # a short write must not report success on a torn line
+            n = os.write(fd, buf)
+            if n <= 0:
+                raise OSError(f"short write appending to {p}")
+            buf = buf[n:]
+    finally:
+        os.close(fd)
+    return p
+
+
+def read_ledger(path: str | None = None) -> LedgerRead:
+    """All parseable, schema-valid records in append order. Unusable
+    lines are reported, not fatal: a crash mid-append must not take the
+    whole history down with it."""
+    p = ledger_path(path)
+    records: list[dict] = []
+    skipped: list[str] = []
+    if not os.path.exists(p):
+        return LedgerRead(records, skipped)
+    with open(p, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                skipped.append(f"line {i}: unparseable ({e.msg})")
+                continue
+            errors = validate_record(rec)
+            if errors:
+                skipped.append(f"line {i}: invalid ({errors[0]}"
+                               + (f" +{len(errors) - 1} more" if len(errors) > 1
+                                  else "") + ")")
+                continue
+            records.append(rec)
+    return LedgerRead(records, skipped)
+
+
+# ---------------------------------------------------------------------------
+# Import of driver-written BENCH_r*.json artifacts (pre-ledger history)
+# ---------------------------------------------------------------------------
+
+def bench_json_to_record(doc: dict, source: str = "") -> dict:
+    """Convert one driver BENCH_r*.json document (or a bare bench.py JSON
+    line) into a PerfRecord. Provenance that the old artifact never
+    carried is recorded as unknown — imported history is explicitly
+    second-class, never dressed up as harness-grade."""
+    parsed = doc.get("parsed") if "parsed" in doc else doc
+    if not isinstance(parsed, dict) or "value" not in parsed:
+        raise ValueError(f"{source or 'document'}: no parsed benchmark "
+                         "result to import")
+    extra = dict(parsed.get("extra") or {})
+    platform = str(extra.get("platform", "unknown") or "unknown")
+    if platform not in ("tpu", "cpu", "gpu", "none"):
+        platform = "unknown"
+    degraded = bool(extra.get("degraded", False))
+    stages: dict[str, dict[str, float]] = {}
+    if isinstance(extra.get("host_plane_ev_per_s"), (int, float)):
+        stages["pop"] = {"ev_per_s": float(extra["host_plane_ev_per_s"])}
+    if isinstance(extra.get("device_plane_ev_per_s"), (int, float)):
+        stages["bundle_update"] = {
+            "ev_per_s": float(extra["device_plane_ev_per_s"])}
+    if isinstance(extra.get("merge_ms_p50"), (int, float)):
+        stages["merge"] = {"ms_p50": float(extra["merge_ms_p50"])}
+    probe = {"outcome": "imported", "attempts": []}
+    err = extra.get("error")
+    if isinstance(err, dict) and err:
+        probe["detail"] = "; ".join(f"{k}: {v}" for k, v in err.items())
+    prov = {
+        "git_sha": "unknown",
+        "git_dirty": False,
+        "host": {"hostname": "unknown", "machine": "unknown",
+                 "python": "unknown"},
+        "platform": platform,
+        "degraded": degraded,
+        "probe": probe,
+    }
+    imported_extra = {"imported_from": source or "bench-json",
+                      **{k: v for k, v in extra.items()
+                         if isinstance(v, (int, float, str, bool))}}
+    if "n" in doc:
+        imported_extra["round"] = doc["n"]
+    return make_record(
+        config="bench.e2e",
+        metric=str(parsed.get("metric", "sketch_ingest_throughput_e2e")),
+        unit=str(parsed.get("unit", "events/sec/chip")),
+        value=float(parsed["value"]),
+        stages=stages,
+        provenance=prov,
+        extra=imported_extra,
+    )
+
+
+def import_bench_files(paths: Iterable[str],
+                       ledger: str | None = None) -> tuple[int, list[str]]:
+    """Append a record per importable BENCH file; returns (imported,
+    ['path: why skipped']). Already-imported files (same imported_from)
+    are skipped so re-running is idempotent."""
+    existing = {r.get("extra", {}).get("imported_from")
+                for r in read_ledger(ledger).records}
+    n = 0
+    skipped: list[str] = []
+    for path in paths:
+        name = os.path.basename(path)
+        if name in existing:
+            skipped.append(f"{path}: already imported")
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            rec = bench_json_to_record(doc, source=name)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            skipped.append(f"{path}: {e}")
+            continue
+        append_record(rec, ledger)
+        n += 1
+    return n, skipped
+
+
+__all__ = ["DEFAULT_LEDGER", "LedgerRead", "SCHEMA_ID", "append_record",
+           "bench_json_to_record", "import_bench_files", "ledger_path",
+           "read_ledger"]

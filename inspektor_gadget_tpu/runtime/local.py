@@ -64,6 +64,18 @@ class LocalRuntime(Runtime):
 
     def _run_traced(self, ctx, on_event, on_event_array, on_batch):
         gadget = ctx.desc.new_instance(ctx)
+        from ..gadgets.interface import GadgetType
+        if (ctx.desc.gadget_type in (GadgetType.PROFILE,
+                                     GadgetType.START_STOP)
+                and not isinstance(gadget, RunWithResult)):
+            # a result-typed gadget without run_with_result would fall
+            # through to run() and the caller would wait on a result
+            # that never comes — fail loudly at wiring time instead
+            raise TypeError(
+                f"{ctx.desc.full_name} is registered as "
+                f"{ctx.desc.gadget_type.value} but its gadget class "
+                f"{type(gadget).__name__} does not implement "
+                f"run_with_result")
         instances = install_operators(ctx, gadget, ctx.operator_params)
 
         if on_event is not None and isinstance(gadget, EventHandlerSetter):

@@ -33,6 +33,10 @@ from .logger import get_logger
 
 DEFAULT_PROBE_TIMEOUT = float(os.environ.get("IG_PLATFORM_PROBE_TIMEOUT",
                                              "20"))
+DEFAULT_PROBE_ATTEMPTS = int(os.environ.get("IG_PLATFORM_PROBE_ATTEMPTS",
+                                            "3"))
+DEFAULT_PROBE_HORIZON = float(os.environ.get("IG_PLATFORM_PROBE_HORIZON",
+                                             "60"))
 
 log = get_logger("ig-tpu.platform")
 
@@ -185,4 +189,61 @@ def acquire_platform(
     global _last_acquire
     with _mu:
         _last_acquire = out
+    return out
+
+
+def backoff_gaps(attempts: int, horizon: float) -> list[float]:
+    """Sleep gaps between probe attempts: exponentially growing, summing
+    to `horizon` (attempt 1 now, the rest spread so a short tunnel blip
+    is retried quickly and a longer one still gets a late chance)."""
+    n_gaps = max(attempts - 1, 0)
+    if n_gaps == 0 or horizon <= 0:
+        return [0.0] * n_gaps
+    total = float((1 << n_gaps) - 1)  # 1 + 2 + 4 + ...
+    return [horizon * (1 << i) / total for i in range(n_gaps)]
+
+
+def acquire_platform_with_retry(
+    requested: str = "auto",
+    attempts: int | None = None,
+    horizon: float | None = None,
+    timeout: float = DEFAULT_PROBE_TIMEOUT,
+    probe_fn: Callable[[], ProbeResult] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> dict:
+    """acquire_platform with N probe attempts spread over a backoff
+    horizon (VERDICT next-round #2: one tunnel blip must not cost the
+    round's number). Only probe failures (timeout/crash) are retried — a
+    probe that *answers*, tpu or cpu, is authoritative. Returns the
+    acquire_platform dict plus an `attempts` trail, so the whole
+    acquisition story lands in PerfRecord provenance."""
+    # clamp BOTH sources to >=1: an env-misconfigured 0 must degrade the
+    # usual way, not skip the loop and crash on an unset result
+    attempts = max(DEFAULT_PROBE_ATTEMPTS if attempts is None else attempts, 1)
+    horizon = DEFAULT_PROBE_HORIZON if horizon is None else horizon
+    if requested == "cpu":
+        out = acquire_platform(requested, timeout, probe_fn)
+        out["attempts"] = [{"attempt": 1, "ok": True, "platform": "cpu",
+                            "detail": "cpu requested", "elapsed_s": 0.0}]
+        return out
+    gaps = backoff_gaps(attempts, horizon)
+    trail: list[dict] = []
+    res: ProbeResult | None = None
+    for i in range(attempts):
+        res = probe_device_platform(timeout, probe_fn)
+        trail.append({"attempt": i + 1, "ok": res.ok,
+                      "platform": res.platform, "detail": res.detail,
+                      "elapsed_s": round(res.elapsed, 3)})
+        if res.ok:
+            break
+        if i < attempts - 1:
+            log.warning("platform probe attempt %d/%d failed (%s); "
+                        "retrying in %.1fs", i + 1, attempts, res.detail,
+                        gaps[i])
+            sleep(gaps[i])
+    # funnel the final outcome through acquire_platform so the usual
+    # bookkeeping (pin-to-cpu, metrics, flight-recorder facts) applies
+    out = acquire_platform(requested, timeout, probe_fn=lambda: res)
+    out["attempts"] = trail
+    RECORDER.set_fact("platform_probe_attempts", trail)
     return out

@@ -1,0 +1,140 @@
+"""Tier-1 lint: every doc throughput claim must be backed by a
+ledger/BENCH artifact with matching platform/degraded provenance
+(tools/check_perf_claims.py — the drift that produced the round-5
+"77.9M ev/s, real TPU" claim from a degraded CPU record becomes a test
+failure), plus self-tests that the checker catches each failure mode.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from tools.check_perf_claims import (
+    check_claim,
+    check_repo,
+    collect_backings,
+    extract_claims,
+)
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_repo_docs_have_backed_claims():
+    violations, checked, _waived = check_repo(ROOT)
+    assert not violations, "\n".join(violations)
+    assert checked > 0, "checker found no claims at all — regex broke?"
+
+
+def _repo_with(tmp_path, doc: str, bench: dict | None = None):
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "performance.md").write_text(doc)
+    if bench is not None:
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(bench))
+    return tmp_path
+
+
+TPU_BENCH = {"parsed": {"value": 76.4e6, "unit": "events/sec/chip",
+                        "extra": {"platform": "tpu", "degraded": False}}}
+CPU_BENCH = {"parsed": {"value": 77.9e6, "unit": "events/sec/chip",
+                        "extra": {"platform": "cpu", "degraded": True}}}
+
+
+def test_backed_claim_passes(tmp_path):
+    root = _repo_with(tmp_path, "measured **76.4M ev/s** on TPU\n",
+                      TPU_BENCH)
+    violations, checked, _ = check_repo(root)
+    assert violations == [] and checked == 1
+
+
+def test_unbacked_claim_fails(tmp_path):
+    # 77.9M with only a 76.4M record on disk: the round-5 figure, a
+    # near-miss that must NOT count as backed (1% tolerance)
+    root = _repo_with(tmp_path, "headline: 77.9M ev/s, real TPU\n",
+                      TPU_BENCH)
+    violations, _, _ = check_repo(root)
+    assert len(violations) == 1
+    assert "NO ledger/BENCH artifact" in violations[0]
+
+
+def test_degraded_backing_must_be_labeled(tmp_path):
+    # the exact round-5 failure: a number whose only artifact is a
+    # degraded CPU record, presented without saying so
+    root = _repo_with(tmp_path, "headline: 77.9M ev/s, real TPU\n",
+                      CPU_BENCH)
+    violations, _, _ = check_repo(root)
+    assert len(violations) == 1
+    assert "degraded/CPU" in violations[0]
+    # the same number WITH the label passes
+    root = _repo_with(tmp_path,
+                      "round 5: 77.9M ev/s (degraded CPU fallback)\n",
+                      CPU_BENCH)
+    violations, _, _ = check_repo(root)
+    assert violations == []
+
+
+def test_targets_and_waivers_skipped(tmp_path):
+    doc = ("target: ≥5M ev/s per node\n"
+           "observed ~123M ev/s once (unrecorded in-round run)\n")
+    root = _repo_with(tmp_path, doc, TPU_BENCH)
+    violations, checked, waived = check_repo(root)
+    assert violations == [] and checked == 0 and waived == 1
+
+
+def test_range_claims_match_any_value_inside(tmp_path):
+    root = _repo_with(tmp_path, "sustained 51–76M events/sec/chip (TPU)\n",
+                      TPU_BENCH)  # 76.4M sits at the top of the range
+    violations, checked, _ = check_repo(root)
+    assert violations == [] and checked == 1
+
+
+def test_approx_claims_get_wider_tolerance(tmp_path):
+    # ~80M vs a 76.4M artifact: 4.5% — inside the 15% approx band,
+    # outside nothing; a plain 80M claim (4.7% off) still passes 5%?
+    # no: 80 vs 76.4 is 4.5% of 80 → borderline; use 85M to be clear
+    root = _repo_with(tmp_path, "roughly ~85M ev/s\n", TPU_BENCH)
+    violations, _, _ = check_repo(root)
+    assert violations == []
+    root = _repo_with(tmp_path, "exactly 85M ev/s\n", TPU_BENCH)
+    violations, _, _ = check_repo(root)
+    assert len(violations) == 1
+
+
+def test_ledger_records_back_claims(tmp_path):
+    from inspektor_gadget_tpu.perf import append_record, make_record
+    ledger_dir = tmp_path / "benchmarks" / "ledger"
+    ledger_dir.mkdir(parents=True)
+    rec = make_record(
+        config="harness.e2e", metric="m", unit="events/sec/chip",
+        value=42e6,
+        stages={"fold32": {"ev_per_s": 200e6, "seconds": 0.5}},
+        provenance={"git_sha": "abc", "git_dirty": False,
+                    "host": {"hostname": "h", "machine": "m",
+                             "python": "3"},
+                    "platform": "tpu", "degraded": False,
+                    "probe": {"outcome": "ok", "attempts": []}})
+    append_record(rec, str(ledger_dir / "PERF.jsonl"))
+    root = _repo_with(tmp_path,
+                      "42M ev/s e2e; fold stage 200M ev/s\n")
+    violations, checked, _ = check_repo(root)
+    assert violations == [] and checked == 2
+
+
+def test_extract_claims_shapes():
+    claims = extract_claims(
+        "a 5.1-6.0M ev/s b ~2.8B events/sec/chip c ≥5M ev/s d "
+        "130.5M ev/s", "f.md")
+    by_text = {c.text.strip(): c for c in claims}
+    rng = by_text["5.1-6.0M ev/s"]
+    assert (rng.lo, rng.hi) == (5.1e6, 6.0e6)
+    assert by_text["~2.8B events/sec"].approx  # match stops at /sec
+    assert [c for c in claims if c.skipped and "target" in c.skipped]
+    assert by_text["130.5M ev/s"].lo == 130.5e6
+
+
+def test_check_claim_nearest_hint(tmp_path):
+    root = _repo_with(tmp_path, "x\n", TPU_BENCH)
+    backings = collect_backings(root)
+    claims = extract_claims("we do 999M ev/s\n", "f.md")
+    msg = check_claim(claims[0], backings)
+    assert "nearest artifact value" in msg
