@@ -77,6 +77,7 @@ class AgentClient:
         on_array: Callable[[str, list], None] | None = None,
         on_batch: Callable[[str, Any], None] | None = None,
         on_summary: Callable[[str, dict], None] | None = None,
+        on_alert: Callable[[str, dict], None] | None = None,
         on_log: Callable[[str, int, str, dict], None] | None = None,
         stop_event: threading.Event | None = None,
         trace_ctx=None,
@@ -140,6 +141,9 @@ class AgentClient:
                 elif t == wire.EV_SUMMARY:
                     if on_summary:
                         on_summary(self.node_name, wire.decode_summary(header, payload))
+                elif t == wire.EV_ALERT:
+                    if on_alert:
+                        on_alert(self.node_name, header.get("alert", {}))
                 elif t == wire.EV_RESULT:
                     out["error"] = header.get("error")
                     out["result"] = payload or None

@@ -278,6 +278,13 @@ class AgentServer:
                 push(wire.EV_SUMMARY, {"node": self.node_name, **h}, payload)
             ctx.extra["on_sketch_summary"] = on_summary
 
+        # alert transitions ride the same stream as typed events whenever
+        # the alerts operator is enabled for this run (rules set); the
+        # client's GrpcRuntime folds them cluster-wide
+        def on_alert_event(alert: dict):
+            push(wire.EV_ALERT, {"node": self.node_name, "alert": alert})
+        ctx.extra["on_alert_event"] = on_alert_event
+
         # control reader: client stop requests cancel the context
         def control_loop():
             try:
@@ -438,8 +445,12 @@ class AgentServer:
                 ]
         except Exception as e:
             dump_error = f"container dump failed: {e!r}"
+        # the node's alert table rides the same debug dump, so a remote
+        # `ig-tpu alerts list` can read every agent's active alerts
+        from ..alerts import ACTIVE as active_alerts
         msg = {"threads": frames, "active_runs": runs,
                "containers": containers,
+               "alerts": active_alerts.all(),
                # CRD-path state rides the same debug dump (the reference's
                # daemon dumps its trace list alongside containers)
                "traces": [{"name": t["metadata"]["name"],

@@ -25,6 +25,7 @@ EV_RESULT = 3           # final result bytes (RunWithResult)
 EV_BATCH_NPZ = 4        # columnar EventBatch as npz
 EV_SUMMARY = 5          # sketch summary (mergeable state digest)
 EV_CONTROL_ACK = 6
+EV_ALERT = 7            # alert lifecycle transition (alerts/engine.py)
 EV_LOG_SHIFT = 16       # type >> 16 = severity when nonzero
 
 

@@ -1,0 +1,80 @@
+"""Pluggable alert delivery.
+
+The sink API is deliberately tiny — `emit(AlertEvent)` — so operators can
+bolt on pagers/webhooks without touching the engine. Two built-ins:
+
+- LogSink: one log line per transition on the run's logger (which the
+  agent multiplexes onto the client stream, so remote transitions show up
+  client-side even without the typed EV_ALERT path).
+- WebhookFileSink: appends each transition as one JSON line to a file —
+  the webhook stand-in tests and air-gapped deployments assert against
+  (O_APPEND single-write, same crash-safety stance as the perf ledger).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Protocol, runtime_checkable
+
+from .engine import AlertEvent
+
+_SEV_LEVEL = {"info": logging.INFO, "warning": logging.WARNING,
+              "critical": logging.ERROR}
+
+
+@runtime_checkable
+class AlertSink(Protocol):
+    def emit(self, event: AlertEvent) -> None: ...
+
+
+class LogSink:
+    def __init__(self, logger: logging.Logger | None = None):
+        self.logger = logger or logging.getLogger("ig-tpu.alerts")
+
+    def emit(self, event: AlertEvent) -> None:
+        self.logger.log(
+            _SEV_LEVEL.get(event.severity, logging.WARNING),
+            "alert %s %s%s: value=%.6g threshold=%.6g [%s]",
+            event.rule, event.transition,
+            f" key={event.key}" if event.key else "",
+            event.value, event.threshold, event.severity)
+
+
+class WebhookFileSink:
+    """JSON-lines delivery to a file path (the test/webhook stand-in).
+
+    Each transition is one `json.dumps` + single O_APPEND write, so
+    concurrent engines can share a file without interleaving lines.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def emit(self, event: AlertEvent) -> None:
+        line = json.dumps(event.to_dict(), separators=(",", ":")) + "\n"
+        fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, line.encode())
+        finally:
+            os.close(fd)
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Read back a sink file, tolerating a crash-truncated tail."""
+        out: list[dict] = []
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        break  # torn tail — everything before it is good
+        except OSError:
+            pass
+        return out

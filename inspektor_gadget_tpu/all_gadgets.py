@@ -11,6 +11,7 @@ from .gadgets.top import block_io as _top_block_io  # noqa: F401
 from .gadgets.top import sketch as _top_sketch  # noqa: F401
 from .gadgets.top import self as _top_self  # noqa: F401
 from .gadgets.top import metrics as _top_metrics  # noqa: F401
+from .gadgets.top import alerts as _top_alerts  # noqa: F401
 from .gadgets.snapshot import process as _snap_process  # noqa: F401
 from .gadgets.snapshot import socket as _snap_socket  # noqa: F401
 from .gadgets.profile import cpu as _profile_cpu  # noqa: F401
@@ -23,3 +24,4 @@ from .operators import localmanager as _localmanager  # noqa: F401
 from .operators import tpusketch as _tpusketch  # noqa: F401
 from .operators import kubemanager as _kubemanager  # noqa: F401
 from .operators import kubeipresolver as _kubeipresolver  # noqa: F401
+from .operators import alertsop as _alertsop  # noqa: F401

@@ -131,6 +131,11 @@ def build_parser() -> argparse.ArgumentParser:
     from .bench import add_bench_parser
     add_bench_parser(sub)
 
+    # sketch-to-signal alerting plane: active alerts, rule validation,
+    # rule dry-runs against recorded summaries
+    from .alerts import add_alerts_parser
+    add_alerts_parser(sub)
+
     vp = sub.add_parser("version", help="print version")
     vp.set_defaults(func=lambda a: (print(_version()), 0)[1])
 
@@ -532,6 +537,25 @@ def cmd_run(args) -> int:
             sys.stdout.flush()
         extra["on_sketch_summary"] = print_summary
 
+    # local runs surface alert transitions inline (remote runs ride the
+    # EV_ALERT stream through the GrpcRuntime dedup instead)
+    alerts_set = False
+    if "operator.alerts." in op_params:
+        alp = op_params["operator.alerts."]
+        alerts_set = bool(
+            ("rules-file" in alp and alp.get("rules-file").as_string())
+            or ("rules" in alp and alp.get("rules").as_string()))
+    if alerts_set and not args.remote:
+        def print_alert(ev: dict):
+            key = f" key={ev['key']}" if ev.get("key") else ""
+            sys.stdout.write(
+                f"\n!! alert {ev['rule']} -> {ev['transition']}{key} "
+                f"value={ev.get('value', 0):.6g} "
+                f"threshold={ev.get('threshold', 0):g} "
+                f"[{ev.get('severity', '')}]\n")
+            sys.stdout.flush()
+        extra["on_alert_event"] = print_alert
+
     extra["output"] = args.output
     ctx = GadgetContext(
         desc,
@@ -616,12 +640,26 @@ def cmd_run(args) -> int:
             threading.Thread(target=ctx.wait_for_timeout_or_done,
                              daemon=True).start()
 
+    run_kwargs = {}
+    if alerts_set and args.remote:
+        # cluster-folded alerts from the GrpcRuntime dedup
+        def print_cluster_alert(ev: dict):
+            nodes = ",".join(ev.get("nodes") or [])
+            key = f" key={ev['key']}" if ev.get("key") else ""
+            sys.stdout.write(
+                f"\n!! alert {ev['rule']} -> {ev['transition']}{key} "
+                f"value={ev.get('value', 0):.6g} nodes=[{nodes}] "
+                f"[{ev.get('severity', '')}]\n")
+            sys.stdout.flush()
+        run_kwargs["on_alert"] = print_cluster_alert
+
     result = runtime.run_gadget(
         ctx,
         on_event=on_event if desc.gadget_type in (GadgetType.TRACE,) else None,
         on_event_array=on_event_array
         if desc.gadget_type in (GadgetType.TRACE_INTERVALS, GadgetType.ONE_SHOT)
         else None,
+        **run_kwargs,
     )
     errs = result.errors()
     if errs:

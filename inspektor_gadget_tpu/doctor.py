@@ -243,6 +243,27 @@ def _probe_blktrace() -> Window:
         return Window("blktrace", False, repr(e))
 
 
+def _probe_container_runtime() -> Window:
+    """Runtime-availability row: can the container discovery/enrichment
+    chain reach a real runtime (docker / containerd / CRI)? The real-
+    runtime integration tier (tests/test_real_runtime.py) keys off the
+    same sockets this probe checks."""
+    try:
+        from .containers.runtime_client import detect_runtime_client
+        client = detect_runtime_client()
+        if client is None:
+            return Window("container_runtime", False,
+                          "no runtime reachable (docker/containerd/CRI "
+                          "sockets absent)")
+        name = type(client).__name__.removesuffix("Client").lower()
+        closer = getattr(client, "close", None)
+        if closer is not None:
+            closer()
+        return Window("container_runtime", True, f"{name} reachable")
+    except Exception as e:  # noqa: BLE001
+        return Window("container_runtime", False, repr(e))
+
+
 def _probe_mountinfo() -> Window:
     try:
         with open("/proc/self/mountinfo") as f:
@@ -267,7 +288,7 @@ _PROBES = (
     _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
     _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,
-    _probe_sigtrace,
+    _probe_sigtrace, _probe_container_runtime,
 )
 
 

@@ -360,6 +360,7 @@ class TraceWatcher:
             annotations = doc.get("metadata", {}).get("annotations", {})
             if OPERATION_ANNOTATION not in annotations:
                 continue
+            consumed_op = annotations[OPERATION_ANNOTATION]
             node = doc.get("spec", {}).get("node", "")
             if node and node != self.store.reconciler.node_name:
                 continue  # node filter (ref: :172-175)
@@ -380,13 +381,139 @@ class TraceWatcher:
                        "metadata": {**doc.get("metadata", {}),
                                     "annotations": new_annotations},
                        "status": status}
-            try:
-                self.client.send(self._path(name), updated, method="PUT")
+            if self._write_back(name, updated, new_annotations, status,
+                                consumed_op):
                 served += 1
-            except Exception as e:  # noqa: BLE001 — keep reconciling others
-                logging.getLogger("ig-tpu.tracewatcher").warning(
-                    "status writeback for %s failed: %s", name, e)
         return served
+
+    WRITE_RETRIES = 3  # conflict retries before giving up on one cycle
+
+    def _write_back(self, name: str, updated: dict, annotations: dict,
+                    status: dict, consumed_op: str = "") -> bool:
+        """PUT the reconciled doc back, surviving the two apiserver
+        rejections a live reconciler actually meets (VERDICT #9):
+
+        - 409 resourceVersion conflict (someone updated the resource
+          between our list and our PUT): re-GET the fresh document,
+          re-apply OUR annotations + status onto ITS metadata (picking up
+          the new resourceVersion), and retry — never drop the writeback,
+          or the consumed operation annotation re-fires forever.
+        - status-subresource rejection (409/422 naming the status
+          subresource): write the main resource without status, then PUT
+          the status to `<path>/status` — the Status().Update split the
+          real controller performs.
+        """
+        log = logging.getLogger("ig-tpu.tracewatcher")
+        doc = updated
+        for attempt in range(1 + self.WRITE_RETRIES):
+            try:
+                self.client.send(self._path(name), doc, method="PUT")
+                return True
+            except Exception as e:  # noqa: BLE001 — classified below
+                code = getattr(e, "code", 0)
+                detail = self._http_detail(e)
+                if code == 422 and "status" in detail.lower():
+                    return self._write_split(name, doc, status, log,
+                                             annotations, consumed_op)
+                if code != 409 or attempt == self.WRITE_RETRIES:
+                    log.warning("status writeback for %s failed: %s",
+                                name, e)
+                    return False
+                # conflict: re-poll the resource and graft our update onto
+                # its current metadata (fresh resourceVersion). The fresh
+                # annotations WIN over our stale snapshot — the concurrent
+                # writer may have added keys (even a NEW operation, which
+                # must survive to be served next poll); we only strip the
+                # operation annotation when it is still the one we just
+                # consumed.
+                try:
+                    fresh = self.client.get(self._path(name))
+                except Exception as ge:  # noqa: BLE001 — retry loop logs
+                    log.warning("conflict re-poll for %s failed: %s",
+                                name, ge)
+                    return False
+                doc = self._graft(fresh, annotations, consumed_op,
+                                  status=status)
+                log.debug("writeback conflict for %s; retrying with "
+                          "resourceVersion %s", name,
+                          doc["metadata"].get("resourceVersion"))
+        return False
+
+    @staticmethod
+    def _graft(fresh: dict, annotations: dict, consumed_op: str,
+               status: dict | None) -> dict:
+        """Build a retry document on top of the freshly-GET resource: the
+        fresh annotations WIN over our stale snapshot (a concurrent
+        writer may have added keys, even a NEW operation which must
+        survive to be served next poll); only the operation annotation we
+        just consumed is stripped. status=None omits status entirely (the
+        status-subresource main-resource half)."""
+        fresh_ann = dict(fresh.get("metadata", {}).get("annotations") or {})
+        if fresh_ann.get(OPERATION_ANNOTATION) == consumed_op:
+            fresh_ann.pop(OPERATION_ANNOTATION, None)
+        out = {**fresh,
+               "metadata": {**fresh.get("metadata", {}),
+                            "annotations": {**annotations, **fresh_ann}}}
+        if status is None:
+            out.pop("status", None)
+        else:
+            out["status"] = status
+        return out
+
+    def _write_split(self, name: str, doc: dict, status: dict, log,
+                     annotations: dict, consumed_op: str) -> bool:
+        """Status-subresource path: PUT the status to <path>/status FIRST,
+        then the main resource (which consumes the operation annotation).
+        Status-first matters: the main PUT is the irreversible half — if
+        it ran first and the status PUT then failed, the annotation would
+        already be consumed and no later poll would retry, stranding the
+        resource on its stale status forever. This order fails towards
+        at-least-once: a failed main PUT leaves the annotation in place
+        and the next cycle re-reconciles.
+
+        The /status write bumps resourceVersion on a real apiserver, so
+        the follow-up main PUT re-polls on 409 instead of giving up —
+        otherwise the annotation would re-fire the operation every poll
+        forever."""
+        main = {k: v for k, v in doc.items() if k != "status"}
+        try:
+            self.client.send(self._path(name) + "/status",
+                             {**main, "status": status}, method="PUT")
+        except Exception as e:  # noqa: BLE001 — keep reconciling others
+            log.warning("status-subresource writeback for %s failed: %s",
+                        name, e)
+            return False
+        for attempt in range(1 + self.WRITE_RETRIES):
+            try:
+                self.client.send(self._path(name), main, method="PUT")
+                return True
+            except Exception as e:  # noqa: BLE001 — classified below
+                if (getattr(e, "code", 0) != 409
+                        or attempt == self.WRITE_RETRIES):
+                    log.warning("status-subresource main writeback for "
+                                "%s failed: %s", name, e)
+                    return False
+                try:
+                    fresh = self.client.get(self._path(name))
+                except Exception as ge:  # noqa: BLE001 — retry loop logs
+                    log.warning("conflict re-poll for %s failed: %s",
+                                name, ge)
+                    return False
+                main = self._graft(fresh, annotations, consumed_op,
+                                   status=None)
+        return False
+
+    @staticmethod
+    def _http_detail(e: Exception) -> str:
+        """Best-effort rejection reason off an HTTPError body (consumed
+        once here — urllib bodies are read-once streams)."""
+        read = getattr(e, "read", None)
+        if callable(read):
+            try:
+                return read().decode("utf-8", "replace")
+            except (OSError, ValueError):
+                return str(e)
+        return str(e)
 
     def start(self) -> None:
         if self._thread:

@@ -86,6 +86,9 @@ class SketchSummary:
     anomaly: dict[int, float] | None = None  # mntns-slot → score
     epoch: int = 0
     names: dict[int, str] = dataclasses.field(default_factory=dict)  # key32 → label
+    # flat numeric access for detector rules lives in ONE place:
+    # alerts.rules.summary_fields (handles this dataclass and the
+    # wire-decoded dict shape alike)
 
 
 # -- checkpoint/resume plumbing ---------------------------------------------
@@ -431,8 +434,13 @@ class TpuSketchInstance(OperatorInstance):
             epoch=self._epoch,
             names={k: self._names[k] for k, _ in hh if k in self._names},
         )
-        if self.on_summary is not None:
-            self.on_summary(summary)
+        # read the consumer LIVE from ctx.extra (falling back to the one
+        # captured at init): the alerts operator chains its engine into
+        # the summary path by swapping this key, and instantiation order
+        # between operators must not decide whether detection happens
+        cb = self.ctx.extra.get("on_sketch_summary", self.on_summary)
+        if cb is not None:
+            cb(summary)
         self._m_harvests.inc()
         self._m_harvest_s.observe(time.perf_counter() - t0)
         return summary

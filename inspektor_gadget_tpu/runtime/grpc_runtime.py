@@ -92,6 +92,7 @@ class GrpcRuntime(Runtime):
         on_event_array: Callable[[list], None] | None = None,
         on_batch: Callable[[Any], None] | None = None,
         on_summary: Callable[[str, dict], None] | None = None,
+        on_alert: Callable[[dict], None] | None = None,
     ) -> CombinedGadgetResult:
         # the client runtime mints the trace: one trace ID per gadget run,
         # propagated through every node's RunGadget request so client,
@@ -102,7 +103,7 @@ class GrpcRuntime(Runtime):
                                 "gadget": ctx.desc.full_name}) as root:
             ctx.extra["trace_ctx"] = root.context
             return self._run_fanout(ctx, root, on_event, on_event_array,
-                                    on_batch, on_summary)
+                                    on_batch, on_summary, on_alert)
 
     def _run_fanout(
         self,
@@ -112,6 +113,7 @@ class GrpcRuntime(Runtime):
         on_event_array: Callable[[list], None] | None,
         on_batch: Callable[[Any], None] | None,
         on_summary: Callable[[str, dict], None] | None,
+        on_alert: Callable[[dict], None] | None,
     ) -> CombinedGadgetResult:
         node_filter = ""
         if "node" in ctx.runtime_params:
@@ -187,6 +189,17 @@ class GrpcRuntime(Runtime):
             elif on_event_array is not None:
                 on_event_array(evs)
 
+        # cluster-wide alert dedup: the same rule+key firing on N nodes
+        # folds into ONE alert carrying the node list; resolved only when
+        # the last node resolves (PSketch's priority-flow fan-in, here at
+        # the client tier)
+        from ..alerts import ClusterAlertAggregator
+        aggregator = ClusterAlertAggregator(on_alert)
+
+        def on_node_alert(node: str, alert: dict):
+            _mark(node, 0)
+            aggregator.observe(node, alert)
+
         def on_remote_log(n: str, sev: int, msg: str, header: dict):
             # remote run/trace IDs ride the record as attrs, so the
             # flight recorder can correlate the line with its spans
@@ -209,6 +222,7 @@ class GrpcRuntime(Runtime):
                         on_json=on_json, on_array=on_array,
                         on_batch=(lambda n, b: on_batch(b)) if on_batch else None,
                         on_summary=on_summary,
+                        on_alert=on_node_alert,
                         on_log=on_remote_log,
                         stop_event=stop_event,
                         trace_ctx=nsp.context,
@@ -227,6 +241,11 @@ class GrpcRuntime(Runtime):
                     _tm_node_errors.labels(node=node).inc()
                     with results_mu:
                         results[node] = GadgetResult(error=str(e))
+                finally:
+                    # stream end reconciles this node's alerts: a dropped
+                    # EV_ALERT 'resolved' (or a crashed node) must not
+                    # wedge a cluster alert active forever
+                    aggregator.node_done(node)
 
         threads = [threading.Thread(target=run_node, args=(n,), daemon=True)
                    for n in nodes]

@@ -60,6 +60,8 @@ class LocalRuntime(Runtime):
                          attrs={"run_id": ctx.run_id,
                                 "node": self.node_name}) as span:
             ctx.extra["trace_ctx"] = span.context
+            # node identity for operators that stamp events (alerts)
+            ctx.extra.setdefault("node", self.node_name)
             return self._run_traced(ctx, on_event, on_event_array, on_batch)
 
     def _run_traced(self, ctx, on_event, on_event_array, on_batch):

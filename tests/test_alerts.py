@@ -1,0 +1,486 @@
+"""Alerting-plane unit tier: rule loading (every edge case fails LOUDLY
+at load — the satellite contract), the hysteresis + debounce state
+machine under an injected clock, sinks, the cluster dedup aggregator,
+and the CLI verbs."""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.alerts import (
+    AlertEngine,
+    ClusterAlertAggregator,
+    LogSink,
+    RuleError,
+    WebhookFileSink,
+    load_rules,
+    load_rules_file,
+)
+from inspektor_gadget_tpu.alerts.store import ActiveAlerts
+from inspektor_gadget_tpu.operators.tpusketch import SketchSummary
+
+
+def summary(entropy=0.0, events=1000, drops=0, distinct=10.0,
+            hh=((1, 500), (2, 100)), anomaly=None, epoch=1):
+    return SketchSummary(events=events, drops=drops, distinct=distinct,
+                         entropy_bits=entropy,
+                         heavy_hitters=[tuple(x) for x in hh],
+                         anomaly=anomaly, epoch=epoch)
+
+
+# -- rule loading: every edge case is a LOAD-time failure -------------------
+
+def test_load_rules_yaml_and_json():
+    yaml_doc = """
+rules:
+  - id: e1
+    kind: entropy_jump
+    threshold: 1.5
+    for: 250ms
+    cooldown: 2s
+"""
+    (r,) = load_rules(yaml_doc)
+    assert (r.id, r.kind, r.threshold) == ("e1", "entropy_jump", 1.5)
+    assert r.for_s == 0.25 and r.cooldown_s == 2.0
+    assert r.field == "entropy_bits"  # implied by the kind
+    json_doc = json.dumps([{"id": "t1", "kind": "threshold",
+                            "field": "drops", "threshold": 5}])
+    (r,) = load_rules(json_doc)
+    assert r.field == "drops" and r.threshold == 5.0
+
+
+def test_load_rules_empty_document_fails():
+    with pytest.raises(RuleError, match="empty rule document"):
+        load_rules("")
+    with pytest.raises(RuleError, match="no rules"):
+        load_rules("rules: []")
+    with pytest.raises(RuleError, match="no rules"):
+        load_rules("{}")
+
+
+def test_load_rules_unknown_field_fails():
+    doc = json.dumps([{"id": "x", "kind": "threshold",
+                       "field": "entropy_bitz", "threshold": 1}])
+    with pytest.raises(RuleError, match="unknown summary field"):
+        load_rules(doc)
+    doc = json.dumps([{"id": "x", "kind": "ratio", "field": "drops",
+                       "denom": "nope", "threshold": 1}])
+    with pytest.raises(RuleError, match="unknown denom field"):
+        load_rules(doc)
+
+
+def test_load_rules_bad_threshold_type_fails():
+    doc = json.dumps([{"id": "x", "kind": "threshold", "field": "events",
+                       "threshold": "very high"}])
+    with pytest.raises(RuleError, match="threshold must be a number"):
+        load_rules(doc)
+    # bool is not a number here (YAML 'threshold: true' trap)
+    doc = json.dumps([{"id": "x", "kind": "threshold", "field": "events",
+                       "threshold": True}])
+    with pytest.raises(RuleError, match="threshold must be a number"):
+        load_rules(doc)
+
+
+def test_load_rules_duplicate_ids_fail():
+    doc = json.dumps([
+        {"id": "dup", "kind": "threshold", "field": "events",
+         "threshold": 1},
+        {"id": "dup", "kind": "threshold", "field": "drops",
+         "threshold": 2},
+    ])
+    with pytest.raises(RuleError, match="duplicate rule id 'dup'"):
+        load_rules(doc)
+
+
+def test_load_rules_unknown_keys_and_kinds_fail():
+    with pytest.raises(RuleError, match="unknown key"):
+        load_rules(json.dumps([{"id": "x", "kind": "threshold",
+                                "field": "events", "threshold": 1,
+                                "treshold": 2}]))
+    with pytest.raises(RuleError, match="unknown kind"):
+        load_rules(json.dumps([{"id": "x", "kind": "entropy_bump",
+                                "threshold": 1}]))
+    with pytest.raises(RuleError, match="unknown op"):
+        load_rules(json.dumps([{"id": "x", "kind": "threshold",
+                                "field": "events", "op": "=>",
+                                "threshold": 1}]))
+    with pytest.raises(RuleError, match="unknown severity"):
+        load_rules(json.dumps([{"id": "x", "kind": "threshold",
+                                "field": "events", "threshold": 1,
+                                "severity": "apocalyptic"}]))
+    with pytest.raises(RuleError, match="missing 'threshold'"):
+        load_rules(json.dumps([{"id": "x", "kind": "threshold",
+                                "field": "events"}]))
+    with pytest.raises(RuleError, match="missing or non-string 'id'"):
+        load_rules(json.dumps([{"kind": "threshold", "field": "events",
+                                "threshold": 1}]))
+
+
+def test_load_rules_file_missing_and_empty(tmp_path):
+    with pytest.raises(RuleError, match="cannot read rule file"):
+        load_rules_file(str(tmp_path / "absent.yaml"))
+    empty = tmp_path / "empty.yaml"
+    empty.write_text("")
+    with pytest.raises(RuleError, match="empty rule document"):
+        load_rules_file(str(empty))
+
+
+def test_operator_fails_loudly_at_run_start(tmp_path):
+    """A bad rule file fails the RUN (via install_operators), not the
+    first harvest — driven through the real LocalRuntime path."""
+    from inspektor_gadget_tpu.gadgets import GadgetContext, get
+    from inspektor_gadget_tpu.operators import operators as op_registry
+    from inspektor_gadget_tpu.params import Collection
+    from inspektor_gadget_tpu.runtime.local import LocalRuntime
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("rules:\n  - id: x\n    kind: nope\n    threshold: 1\n")
+    desc = get("trace", "exec")
+    gp = desc.params().to_params()
+    gp.set("source", "pysynthetic")
+    op = op_registry.get("alerts")
+    ap = op.instance_params().to_params()
+    ap.set("rules-file", str(bad))
+    ctx = GadgetContext(desc, gadget_params=gp,
+                        operator_params=Collection({"operator.alerts.": ap}),
+                        timeout=0.3)
+    result = LocalRuntime().run_gadget(ctx)
+    err = result.errors().get("local", "")
+    assert "unknown kind" in err, err
+
+
+# -- the state machine under an injected clock ------------------------------
+
+def _engine(doc, **kw):
+    return AlertEngine(load_rules(json.dumps(doc)), node="n0",
+                       dry_run=True, **kw)
+
+
+def test_threshold_debounce_pending_firing_resolved():
+    e = _engine([{"id": "d", "kind": "threshold", "field": "drops",
+                  "op": ">", "threshold": 10, "for": 1.0}])
+    assert e.observe(summary(drops=3), now=0.0) == []
+    (ev,) = e.observe(summary(drops=50), now=1.0)
+    assert ev.transition == "pending" and ev.value == 50
+    assert e.observe(summary(drops=60), now=1.5) == []  # for not elapsed
+    (ev,) = e.observe(summary(drops=60), now=2.1)
+    assert ev.transition == "firing"
+    assert e.firing() == [("d", "")]
+    (ev,) = e.observe(summary(drops=0), now=3.0)
+    assert ev.transition == "resolved"
+    assert e.firing() == []
+
+
+def test_debounce_retracts_pending_without_firing():
+    e = _engine([{"id": "d", "kind": "threshold", "field": "drops",
+                  "op": ">", "threshold": 10, "for": 1.0}])
+    (ev,) = e.observe(summary(drops=50), now=0.0)
+    assert ev.transition == "pending"
+    # condition gone before `for` elapsed: never fires (the debounce),
+    # but the surfaced pending is retracted so consumers drop it
+    (ev,) = e.observe(summary(drops=0), now=0.5)
+    assert ev.transition == "resolved"
+    assert e.firing() == []
+    # and the next trip starts a FRESH pending window
+    (ev,) = e.observe(summary(drops=50), now=1.0)
+    assert ev.transition == "pending"
+    assert e.observe(summary(drops=50), now=1.5) == []
+
+
+def test_hysteresis_clear_level_holds_alert():
+    e = _engine([{"id": "h", "kind": "threshold", "field": "drops",
+                  "op": ">", "threshold": 10, "clear": 5}])
+    evs = e.observe(summary(drops=20), now=0.0)
+    assert [v.transition for v in evs] == ["pending", "firing"]  # for: 0
+    # between clear and threshold: still firing (no flap)
+    assert e.observe(summary(drops=7), now=1.0) == []
+    assert e.firing() == [("h", "")]
+    (ev,) = e.observe(summary(drops=2), now=2.0)  # below clear: released
+    assert ev.transition == "resolved"
+
+
+def test_cooldown_suppresses_retrigger():
+    e = _engine([{"id": "c", "kind": "threshold", "field": "drops",
+                  "op": ">", "threshold": 10, "cooldown": 10.0}])
+    e.observe(summary(drops=20), now=0.0)
+    e.observe(summary(drops=0), now=1.0)   # resolved at t=1
+    assert e.observe(summary(drops=20), now=5.0) == []  # cooling down
+    evs = e.observe(summary(drops=20), now=12.0)        # cooldown over
+    assert [v.transition for v in evs] == ["pending", "firing"]
+
+
+def test_ratio_no_data_does_not_trigger_lt_rules():
+    """events=0 means 'no data', not 'ratio 0' — an op:'<' rule must not
+    trip on the empty first harvest."""
+    e = _engine([{"id": "r", "kind": "ratio", "field": "hh_top_count",
+                  "denom": "events", "op": "<", "threshold": 0.1}])
+    assert e.observe(summary(events=0, hh=()), now=0.0) == []
+
+
+def test_vanished_pending_key_resets_debounce():
+    """A pending whose key vanishes is retracted; a later reuse of the
+    slot starts a FRESH `for` window instead of firing instantly off the
+    frozen `since`."""
+    e = _engine([{"id": "a", "kind": "anomaly_score", "threshold": 0.5,
+                  "for": 30.0}])
+    (ev,) = e.observe(summary(anomaly={1: 0.9}), now=0.0)
+    assert ev.transition == "pending"
+    (ev,) = e.observe(summary(anomaly={}), now=5.0)  # container gone
+    assert (ev.key, ev.transition) == ("mntns:1", "resolved")
+    (ev,) = e.observe(summary(anomaly={1: 0.9}), now=3600.0)  # slot reused
+    assert ev.transition == "pending"
+    assert e.observe(summary(anomaly={1: 0.9}), now=3605.0) == []  # held
+
+
+def test_ratio_rule():
+    e = _engine([{"id": "r", "kind": "ratio", "field": "drops",
+                  "denom": "events", "op": ">", "threshold": 0.01}])
+    assert e.observe(summary(events=1000, drops=5), now=0.0) == []
+    evs = e.observe(summary(events=1000, drops=50), now=1.0)
+    assert evs[-1].transition == "firing" and evs[-1].value == 0.05
+
+
+def test_entropy_jump_uses_baseline_window():
+    e = _engine([{"id": "e", "kind": "entropy_jump", "threshold": 1.0,
+                  "window": 3}])
+    for t, h in enumerate([4.0, 4.1, 3.9]):
+        assert e.observe(summary(entropy=h), now=float(t)) == []
+    evs = e.observe(summary(entropy=7.5), now=3.0)  # jump vs mean(4.0)
+    assert [v.transition for v in evs] == ["pending", "firing"]
+    # entropy stays at the new level: the baseline catches up → resolved
+    out = []
+    for t in range(4, 9):
+        out += e.observe(summary(entropy=7.5), now=float(t))
+    assert [v.transition for v in out] == ["resolved"]
+
+
+def test_cardinality_spike_factor():
+    e = _engine([{"id": "c", "kind": "cardinality_spike", "factor": 3.0,
+                  "window": 2}])
+    assert e.observe(summary(distinct=100), now=0.0) == []
+    assert e.observe(summary(distinct=110), now=1.0) == []
+    evs = e.observe(summary(distinct=900), now=2.0)
+    assert evs[-1].transition == "firing"
+
+
+def test_heavy_hitter_churn_jaccard():
+    e = _engine([{"id": "hh", "kind": "heavy_hitter_churn",
+                  "threshold": 0.5}])
+    base = summary(hh=((1, 9), (2, 8), (3, 7), (4, 6)))
+    assert e.observe(base, now=0.0) == []            # no previous set
+    assert e.observe(base, now=1.0) == []            # identical: dist 0
+    churned = summary(hh=((9, 9), (8, 8), (7, 7), (4, 6)))  # 1 of 7 shared
+    evs = e.observe(churned, now=2.0)
+    assert evs[-1].transition == "firing"
+    assert evs[-1].value > 0.5
+
+
+def test_heavy_hitter_churn_empty_baseline_is_not_churn():
+    """Traffic first appearing (empty → nonempty top-k) is not turnover;
+    churn needs a nonempty baseline."""
+    e = _engine([{"id": "hh", "kind": "heavy_hitter_churn",
+                  "threshold": 0.5}])
+    assert e.observe(summary(hh=()), now=0.0) == []          # empty
+    assert e.observe(summary(hh=((1, 9), (2, 8))), now=1.0) == []
+    # but a REAL full turnover after that baseline still fires
+    evs = e.observe(summary(hh=((8, 9), (9, 8))), now=2.0)
+    assert evs[-1].transition == "firing"
+
+
+def test_anomaly_score_per_container_keys():
+    e = _engine([{"id": "a", "kind": "anomaly_score", "threshold": 0.5}])
+    evs = e.observe(summary(anomaly={111: 0.9, 222: 0.1}), now=0.0)
+    assert {v.key for v in evs} == {"mntns:111"}
+    assert evs[-1].transition == "firing"
+    # second container trips independently; the first stays firing
+    evs = e.observe(summary(anomaly={111: 0.9, 222: 0.8}), now=1.0)
+    assert {v.key for v in evs} == {"mntns:222"}
+    assert set(e.firing()) == {("a", "mntns:111"), ("a", "mntns:222")}
+    # a container that VANISHES resolves its alert (slot gone)
+    evs = e.observe(summary(anomaly={222: 0.8}), now=2.0)
+    assert [(v.key, v.transition) for v in evs] == [
+        ("mntns:111", "resolved")]
+
+
+def test_debounced_pending_does_not_linger_in_active_table():
+    """A pending that never fires emits nothing, but the process-wide
+    table must not keep showing it as pending forever."""
+    from inspektor_gadget_tpu.alerts import ACTIVE, load_rules as _lr
+    rules = _lr(json.dumps([{"id": "linger-test", "kind": "threshold",
+                             "field": "drops", "threshold": 10,
+                             "for": 5.0}]))
+    e = AlertEngine(rules, node="n0")  # real delivery: writes the table
+    e.observe(summary(drops=50), now=0.0)
+    (entry,) = [a for a in ACTIVE.all() if a["rule"] == "linger-test"]
+    assert entry["state"] == "pending"
+    e.observe(summary(drops=0), now=1.0)  # debounced away, silently
+    (entry,) = [a for a in ACTIVE.all() if a["rule"] == "linger-test"]
+    assert entry["state"] == "resolved"
+
+
+def test_engine_close_resolves_active_alerts():
+    """End-of-run teardown: a stopped run must not read as a live
+    incident forever (gauge, table, stream all see the resolve)."""
+    e = _engine([{"id": "c1", "kind": "threshold", "field": "drops",
+                  "threshold": 1},
+                 {"id": "c2", "kind": "threshold", "field": "events",
+                  "threshold": 10, "for": 60.0}])
+    e.observe(summary(drops=5, events=100), now=0.0)
+    assert e.firing() == [("c1", "")]  # c2 still pending (for=60)
+    evs = e.close(now=1.0)
+    assert sorted((v.rule, v.transition) for v in evs) == [
+        ("c1", "resolved"), ("c2", "resolved")]
+    assert e.firing() == []
+    assert e.close(now=2.0) == []  # idempotent
+
+
+def test_aggregator_node_done_reconciles_lost_resolves():
+    """Stream end resolves whatever a node still held active — a dropped
+    EV_ALERT 'resolved' (or a crashed node) must not wedge the cluster
+    alert."""
+    surfaced = []
+    agg = ClusterAlertAggregator(surfaced.append, store=ActiveAlerts())
+    agg.observe("n0", _alert("n0", "firing"))
+    agg.observe("n1", _alert("n1", "firing"))
+    # n0's resolved never arrives; its stream ends
+    assert agg.node_done("n0") == []      # n1 still holds it
+    assert agg.active()                   # cluster alert still active
+    (ev,) = agg.node_done("n1")           # last node out resolves it
+    assert ev["transition"] == "resolved"
+    assert set(ev["nodes"]) == {"n0", "n1"}
+    assert agg.active() == []
+    assert surfaced[-1]["transition"] == "resolved"
+
+
+def test_store_new_episode_resets_node_attribution():
+    """A re-fired alert must not inherit node lists (or age) from prior,
+    resolved episodes."""
+    store = ActiveAlerts()
+    store.update({**_alert("nA", "firing"), "nodes": ["nA"]},
+                 scope="cluster")
+    store.update({**_alert("nA", "resolved"), "nodes": ["nA"]},
+                 scope="cluster")
+    store.update({**_alert("nB", "firing"), "nodes": ["nB"]},
+                 scope="cluster")
+    (entry,) = [a for a in store.all() if a["scope"] == "cluster"]
+    assert entry["nodes"] == ["nB"], entry
+
+
+# -- sinks ------------------------------------------------------------------
+
+def test_webhook_file_sink_json_lines(tmp_path):
+    path = tmp_path / "hooks.jsonl"
+    rules = load_rules(json.dumps(
+        [{"id": "w", "kind": "threshold", "field": "drops",
+          "threshold": 1}]))
+    e = AlertEngine(rules, node="n0", sinks=[WebhookFileSink(str(path))])
+    e.observe(summary(drops=5), now=0.0)
+    e.observe(summary(drops=0), now=1.0)
+    events = WebhookFileSink.read(str(path))
+    assert [ev["transition"] for ev in events] == [
+        "pending", "firing", "resolved"]
+    assert events[0]["rule"] == "w" and events[0]["node"] == "n0"
+    # torn tail is tolerated, prefix survives
+    with open(path, "a") as f:
+        f.write('{"transition": "fir')
+    assert len(WebhookFileSink.read(str(path))) == 3
+
+
+def test_log_sink_levels(caplog):
+    sink = LogSink(logging.getLogger("ig-tpu.alerts.test"))
+    rules = load_rules(json.dumps(
+        [{"id": "l", "kind": "threshold", "field": "drops", "threshold": 1,
+          "severity": "critical"}]))
+    e = AlertEngine(rules, sinks=[sink])
+    with caplog.at_level(logging.INFO, logger="ig-tpu.alerts.test"):
+        e.observe(summary(drops=5), now=0.0)
+    firing = [r for r in caplog.records if "firing" in r.getMessage()]
+    assert firing and firing[0].levelno == logging.ERROR  # critical
+
+
+# -- cluster dedup ----------------------------------------------------------
+
+def _alert(node, transition, rule="r1", key=""):
+    return {"rule": rule, "key": key, "transition": transition,
+            "node": node, "severity": "warning", "kind": "threshold",
+            "value": 1.0, "threshold": 0.5, "ts": 123.0}
+
+
+def test_cluster_dedup_fires_once_for_n_nodes():
+    surfaced = []
+    store = ActiveAlerts()
+    agg = ClusterAlertAggregator(surfaced.append, store=store)
+    assert agg.observe("n0", _alert("n0", "pending")) is not None
+    assert agg.observe("n1", _alert("n1", "pending")) is None  # folded
+    assert agg.observe("n0", _alert("n0", "firing")) is not None
+    assert agg.observe("n1", _alert("n1", "firing")) is None   # folded
+    assert [s["transition"] for s in surfaced] == ["pending", "firing"]
+    # the store's cluster entry carries BOTH nodes
+    (entry,) = [a for a in store.all() if a["scope"] == "cluster"]
+    assert set(entry["nodes"]) == {"n0", "n1"}
+    # resolved only when the LAST node resolves
+    assert agg.observe("n0", _alert("n0", "resolved")) is None
+    assert agg.observe("n1", _alert("n1", "resolved")) is not None
+    assert surfaced[-1]["transition"] == "resolved"
+    assert set(surfaced[-1]["nodes"]) == {"n0", "n1"}
+
+
+def test_cluster_dedup_distinct_keys_fire_separately():
+    surfaced = []
+    agg = ClusterAlertAggregator(surfaced.append, store=ActiveAlerts())
+    agg.observe("n0", _alert("n0", "firing", key="mntns:1"))
+    agg.observe("n1", _alert("n1", "firing", key="mntns:2"))
+    assert len([s for s in surfaced if s["transition"] == "firing"]) == 2
+
+
+# -- CLI verbs --------------------------------------------------------------
+
+RULES_YAML = """
+rules:
+  - id: ej
+    kind: entropy_jump
+    threshold: 1.0
+    window: 3
+  - id: drops
+    kind: ratio
+    field: drops
+    denom: events
+    threshold: 0.01
+"""
+
+
+def test_cli_alerts_rules_ok_and_bad(tmp_path, capsys):
+    from inspektor_gadget_tpu.cli.main import main as cli_main
+    good = tmp_path / "rules.yaml"
+    good.write_text(RULES_YAML)
+    assert cli_main(["alerts", "rules", "--file", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "2 rule(s) ok" in out and "ej:" in out
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("rules:\n  - id: x\n    kind: threshold\n"
+                   "    field: nope\n    threshold: 1\n")
+    assert cli_main(["alerts", "rules", "--file", str(bad)]) == 2
+    assert "unknown summary field" in capsys.readouterr().err
+
+
+def test_cli_alerts_test_replay(tmp_path, capsys):
+    from inspektor_gadget_tpu.cli.main import main as cli_main
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES_YAML)
+    lines = []
+    for h in [4.0, 4.0, 4.0, 8.0, 8.0, 8.0, 8.0, 8.0]:
+        lines.append(json.dumps({"events": 1000, "drops": 0,
+                                 "distinct": 10.0, "entropy": h,
+                                 "heavy_hitters": [[1, 100]], "epoch": 1}))
+    recorded = tmp_path / "summaries.jsonl"
+    recorded.write_text("\n".join(lines))
+    assert cli_main(["alerts", "test", "--file", str(rules),
+                     "--summaries", str(recorded)]) == 0
+    out = capsys.readouterr().out
+    assert "ej -> pending" in out and "ej -> firing" in out
+    assert "ej -> resolved" in out
+    assert "8 summaries" in out

@@ -22,6 +22,15 @@ WORKER = textwrap.dedent("""
     jax.config.update("jax_platforms", "cpu")
 
     coord, pid = sys.argv[1], int(sys.argv[2])
+    # shard_map moved to the jax namespace after 0.4.x and renamed
+    # check_rep -> check_vma; run against both
+    import inspect
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+    _smkw = ({"check_vma": False}
+             if "check_vma" in inspect.signature(shard_map).parameters
+             else {"check_rep": False})
     from inspektor_gadget_tpu.parallel.distributed import (
         init_distributed, make_multihost_mesh, world_size,
     )
@@ -55,13 +64,21 @@ WORKER = textwrap.dedent("""
     all_keys = rng.integers(1, 2**31, (4, per_node), dtype=np.int64)
     global_keys = jnp.asarray(all_keys.astype(np.uint32))
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         node_update, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P(),
-        check_vma=False))
+        **_smkw))
     sharding = NamedSharding(mesh, P(NODE_AXIS))
     garr = jax.make_array_from_process_local_data(sharding, np.asarray(
         all_keys.astype(np.uint32))[pid * 2:(pid + 1) * 2])
-    merged = step(garr)
+    try:
+        merged = step(garr)
+    except Exception as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            # this jaxlib's CPU backend cannot run cross-process
+            # collectives at all — an environment limitation, not a bug
+            print(json.dumps({"skip": str(e)}), flush=True)
+            sys.exit(0)
+        raise
     # out_specs=P() -> replicated result; read this process's local shards
     local = jax.tree.map(lambda a: a.addressable_shards[0].data, merged)
     est = float(hll_estimate(local.hll))
@@ -70,6 +87,13 @@ WORKER = textwrap.dedent("""
     print(json.dumps({"pid": pid, "events": events, "est": est,
                       "true": true_card}))
 """)
+
+
+# Documented budget for a cluster merge racing fixed-rate local ingest in
+# the 4-process world (docs/performance.md "cross-process merge" rows):
+# everything shares ONE contended CPU core in CI, so the budget carries
+# that contention factor rather than pretending each proc owns a core.
+MERGE_UNDER_INGEST_P95_BUDGET_MS = 2500.0
 
 
 def _free_port() -> int:
@@ -81,7 +105,7 @@ def _free_port() -> int:
 
 
 ELASTIC_WORKER = textwrap.dedent("""
-    import json, os, sys, time
+    import json, os, sys, threading, time
     sys.path.insert(0, os.getcwd())
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -90,6 +114,13 @@ ELASTIC_WORKER = textwrap.dedent("""
 
     coord_a, coord_b, pid, tmpdir = (
         sys.argv[1], sys.argv[2], int(sys.argv[3]), sys.argv[4])
+    import inspect
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+    _smkw = ({"check_vma": False}
+             if "check_vma" in inspect.signature(shard_map).parameters
+             else {"check_rep": False})
     from inspektor_gadget_tpu.parallel.distributed import (
         init_distributed, make_multihost_mesh, world_size,
     )
@@ -108,9 +139,12 @@ ELASTIC_WORKER = textwrap.dedent("""
         rng = np.random.default_rng(seed)
         return rng.integers(1, 2**31, n, dtype=np.int64).astype(np.uint32)
 
-    def merge_world(n_procs, bundle):
+    def merge_world(n_procs, bundle, ingest_hz=0):
         '''Stack [bundle, empty] per process (empty is merge-neutral) and
-        psum over the node axis; returns (merged_events, p50_ms).'''
+        psum over the node axis; returns (merged_events, p50_ms, stats).
+        ingest_hz > 0 additionally times the merge ticks WHILE a local
+        ingest thread runs bundle_update at that fixed batch rate — the
+        contention the production agent lives under (VERDICT #5).'''
         mesh = make_multihost_mesh()
         assert mesh.shape[NODE_AXIS] == 2 * n_procs, mesh.shape
         empty = bundle_init(**SHAPE)
@@ -121,18 +155,61 @@ ELASTIC_WORKER = textwrap.dedent("""
         garr = jax.tree.map(
             lambda x: jax.make_array_from_process_local_data(sharding, x),
             stacked)
-        step = jax.jit(jax.shard_map(
+        step = jax.jit(shard_map(
             cluster_merge, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P(),
-            check_vma=False))
+            **_smkw))
         merged = step(garr)
         jax.block_until_ready(merged.events)
-        ticks = []
-        for _ in range(10):
-            t0 = time.perf_counter()
-            jax.block_until_ready(step(garr).events)
-            ticks.append((time.perf_counter() - t0) * 1000.0)
+
+        def timed_ticks(n):
+            ticks = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                jax.block_until_ready(step(garr).events)
+                ticks.append((time.perf_counter() - t0) * 1000.0)
+            return ticks
+
+        idle = timed_ticks(10)
+        stats = {}
+        if ingest_hz:
+            # fixed-rate local ingest (batches of PER_PROC keys) racing
+            # the cluster merges — bundle_update at this shape is already
+            # compiled, so the thread contends on compute, not compile
+            stop = threading.Event()
+            counted = [0]
+
+            def ingest_loop():
+                contend = bundle_init(**SHAPE)
+                period = 1.0 / ingest_hz
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    k = jnp.asarray(local_keys_np(5000 + counted[0]))
+                    contend = bundle_update(
+                        contend, k, k, k, jnp.ones(k.shape, bool))
+                    jax.block_until_ready(contend.events)
+                    counted[0] += 1
+                    left = period - (time.perf_counter() - t0)
+                    if left > 0:
+                        stop.wait(left)
+
+            t = threading.Thread(target=ingest_loop, daemon=True)
+            t.start()
+            time.sleep(0.05)  # let the ingest loop reach steady state
+            under = timed_ticks(10)
+            stop.set()
+            t.join(timeout=10)
+            stats = {
+                "merge_under_ingest_p50_ms":
+                    float(np.percentile(under, 50)),
+                "merge_under_ingest_p95_ms":
+                    float(np.percentile(under, 95)),
+                "merge_idle_p95_ms": float(np.percentile(idle, 95)),
+                "ingest_batches": counted[0],
+                "ingest_hz": ingest_hz,
+            }
         local_m = jax.tree.map(lambda a: a.addressable_shards[0].data, merged)
-        return float(local_m.events), float(np.percentile(ticks, 50))
+        return (float(local_m.events), float(np.percentile(idle, 50)),
+                stats)
 
     # the world must exist BEFORE any jax computation (backends snapshot
     # the distributed config at creation)
@@ -145,9 +222,16 @@ ELASTIC_WORKER = textwrap.dedent("""
     k = jnp.asarray(local_keys_np(100 + pid))
     local = bundle_update(local, k, k, k, jnp.ones(k.shape, bool))
 
-    events1, p50_1 = merge_world(4, local)
+    try:
+        events1, p50_1, contention = merge_world(4, local, ingest_hz=50)
+    except Exception as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            print(json.dumps({"phase": 1, "pid": pid, "skip": str(e)}),
+                  flush=True)
+            sys.exit(0)
+        raise
     print(json.dumps({"phase": 1, "pid": pid, "merged_events": events1,
-                      "merge_p50_ms": p50_1}), flush=True)
+                      "merge_p50_ms": p50_1, **contention}), flush=True)
 
     # host-offload, tear the world down, forget its backend (survivor
     # restart semantics: state lives on the host between worlds)
@@ -172,7 +256,7 @@ ELASTIC_WORKER = textwrap.dedent("""
         k = jnp.asarray(kb)
         local = bundle_update(local, k, k, k, jnp.ones(k.shape, bool))
     assert world_size() == 3
-    events2, p50_2 = merge_world(3, local)
+    events2, p50_2, _ = merge_world(3, local)
     print(json.dumps({"phase": 2, "pid": pid,
                       "local_events": float(local.events),
                       "merged_events": events2,
@@ -197,6 +281,10 @@ def test_two_process_sketch_merge(tmp_path):
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
         outs.append(json.loads(line))
+    skips = [o for o in outs if "skip" in o]
+    if skips:
+        pytest.skip(f"backend cannot run multiprocess collectives: "
+                    f"{skips[0]['skip']}")
     # both processes observed the full 4-node union
     for o in outs:
         assert o["events"] == 4 * 512, o
@@ -262,11 +350,33 @@ def test_four_process_kill_one_and_remerge(tmp_path):
                             phase1[key.data] = rec
                     chunk = key.fileobj.readline()
             check_alive({0, 1, 2, 3})
+        skips = [r for r in phase1.values() if "skip" in r]
+        if skips:
+            pytest.skip(f"backend cannot run multiprocess collectives: "
+                        f"{skips[0]['skip']}")
         assert len(phase1) == 4, f"phase1 incomplete: {phase1}"
         # 4 procs x 512 keys each, merged across the world
         for rec in phase1.values():
             assert rec["merged_events"] == 4 * 512, rec
         p50_4proc = phase1[0]["merge_p50_ms"]
+
+        # merge-under-ingest contention (VERDICT #5): the merges were
+        # timed WHILE every worker ingested at a fixed 50 Hz batch rate;
+        # the ingest threads must have made real progress, and the
+        # contended p95 stays inside the documented budget (the 1-core
+        # contention factor is part of that budget — see
+        # MERGE_UNDER_INGEST_P95_BUDGET_MS and docs/performance.md)
+        for rec in phase1.values():
+            assert rec["ingest_batches"] > 0, (
+                "ingest thread starved out entirely during merges", rec)
+            assert (rec["merge_under_ingest_p95_ms"]
+                    <= MERGE_UNDER_INGEST_P95_BUDGET_MS), rec
+        print("merge under 50Hz ingest: p50 "
+              f"{phase1[0]['merge_under_ingest_p50_ms']:.1f} ms, p95 "
+              f"{phase1[0]['merge_under_ingest_p95_ms']:.1f} ms "
+              f"(idle p50 {p50_4proc:.1f} ms, idle p95 "
+              f"{phase1[0]['merge_idle_p95_ms']:.1f} ms; "
+              f"{phase1[0]['ingest_batches']} batches ingested)")
 
         # SIGKILL worker 3 mid-ingest, then release the survivors; its
         # EOF'd pipe must leave the selector or select() busy-spins

@@ -1,0 +1,92 @@
+"""Real-runtime integration tier (VERDICT #4).
+
+Every other container test fakes the runtime (unshared namespaces, fake
+collections). This tier runs the actual discovery → enrichment → columns
+chain against a REAL container runtime when one is reachable — the
+docker/containerd/CRI socket the doctor's `container_runtime` row probes
+— and skips cleanly everywhere else, so CI hosts with a runtime get the
+coverage and laptops without one lose nothing.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from inspektor_gadget_tpu.containers.runtime_client import (
+    CRI_SOCKETS,
+    DOCKER_SOCKET,
+    detect_runtime_client,
+)
+
+_SOCKETS = (DOCKER_SOCKET, *CRI_SOCKETS)
+
+
+def _any_socket() -> bool:
+    return any(os.path.exists(s) for s in _SOCKETS)
+
+
+NEEDS_RUNTIME = pytest.mark.skipif(
+    not _any_socket(),
+    reason=f"no container runtime socket present (checked {_SOCKETS})")
+
+
+@NEEDS_RUNTIME
+def test_doctor_reports_runtime_row():
+    """The doctor's runtime-availability row must agree with the socket
+    this tier keyed off."""
+    from inspektor_gadget_tpu.doctor import probe_windows, render_report
+    windows = probe_windows()
+    assert "container_runtime" in windows
+    w = windows["container_runtime"]
+    assert w.ok, w.detail
+    report = render_report(windows)
+    assert "container_runtime" in report
+
+
+@NEEDS_RUNTIME
+def test_discovery_enrichment_columns_real_container():
+    """discovery (runtime list) → enrichment (pid → mntns, identity
+    completion) → columns (an event in the container's mntns renders its
+    name) against a live container."""
+    client = detect_runtime_client()
+    if client is None:
+        pytest.skip("runtime socket exists but no client answered")
+    containers = client.get_containers()
+    if not containers:
+        pytest.skip("runtime reachable but no containers running")
+
+    from inspektor_gadget_tpu.containers import ContainerCollection
+    from inspektor_gadget_tpu.containers.runtime_client import (
+        with_runtime_enrichment)
+
+    cc = ContainerCollection()
+    cc.initialize(with_runtime_enrichment(client))
+    discovered = cc.get_all()
+    assert discovered, "runtime listed containers but the collection is empty"
+    by_id = {c.id: c for c in discovered}
+    for c in containers:
+        assert c.id in by_id, f"container {c.id} lost in discovery"
+
+    # enrichment: at least one running container resolves a pid and a
+    # mount namespace (runtime completion + linux-ns enricher)
+    enriched = [c for c in discovered if c.pid and c.mntns]
+    if not enriched:
+        pytest.skip("no discovered container exposes pid+mntns "
+                    "(runtime keeps pids private to this uid?)")
+    target = enriched[0]
+
+    # columns: an event carrying the container's mntns renders its name
+    # through the standard enrichment path the gadgets use
+    from inspektor_gadget_tpu.columns import Columns, TextFormatter
+    from inspektor_gadget_tpu.gadgets.trace.exec import ExecEvent
+
+    ev = ExecEvent(mountnsid=target.mntns, pid=target.pid, comm="real-rt")
+    cc.enrich_event_by_mntns(ev)
+    assert ev.container == target.name, (ev.container, target.name)
+
+    cols = Columns(ExecEvent)
+    fmt = TextFormatter(cols)
+    line = fmt.format_event(ev)
+    assert target.name[:8] in line or target.name in line, line
