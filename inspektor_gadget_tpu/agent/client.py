@@ -9,6 +9,7 @@ grpc target here), GetCatalog with client-side cache fallback
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 from pathlib import Path
@@ -199,6 +200,70 @@ class AgentClient:
         served via DumpState. max_spans>512 pulls deeper into the span
         ring (trace export wants all of it)."""
         return self.dump_state(max_spans=max_spans).get("flight_record", {})
+
+    # -- capture/recording lifecycle (capture/) -----------------------------
+
+    def start_recording(self, recording_id: str, *,
+                        opts: dict | None = None) -> dict:
+        """Journals land under the AGENT's capture area (--capture-dir)
+        — the same base ListRecordings/FetchSegment resolve against."""
+        return self._unary("StartRecording",
+                           {"recording_id": recording_id,
+                            "opts": opts or {}})
+
+    def stop_recording(self, recording_id: str) -> dict:
+        return self._unary("StopRecording", {"recording_id": recording_id})
+
+    def list_recordings(self, recording_id: str = "") -> dict:
+        return self._unary("ListRecordings",
+                           {"recording_id": recording_id})
+
+    def fetch_file(self, recording_id: str, rel_path: str,
+                   dest_path: str, *, chunk: int = 1 << 20) -> int:
+        """Download one recording file in chunks; returns bytes written.
+        The chunked unary keeps every message under gRPC's 4 MiB cap."""
+        method = self.channel.unary_unary(
+            "/igtpu.GadgetManager/FetchSegment",
+            request_serializer=wire.identity_serializer,
+            response_deserializer=wire.identity_deserializer,
+        )
+        os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+        written = 0
+        with open(dest_path, "wb") as f:
+            offset = 0
+            while True:
+                reply = method(wire.encode_msg(
+                    {"recording_id": recording_id, "file": rel_path,
+                     "offset": offset, "limit": chunk}),
+                    timeout=CONNECT_TIMEOUT)
+                h, payload = wire.decode_msg(reply)
+                if h.get("error"):
+                    raise RuntimeError(h["error"])
+                f.write(payload)
+                written += len(payload)
+                offset += len(payload)
+                if h.get("eof") or not payload:
+                    break
+        return written
+
+    def fetch_recording(self, recording_id: str, dest_dir: str) -> dict:
+        """Pull every file of one recording into dest_dir (mirroring the
+        node's relative layout); returns {files, bytes}. The server's
+        listing is NOT trusted: an absolute or ..-escaping relative path
+        from a compromised agent must not write outside dest_dir
+        (zip-slip), so such entries are refused loudly."""
+        listing = self.list_recordings(recording_id)
+        files = listing.get("files") or []
+        total = 0
+        for item in files:
+            rel = os.path.normpath(item["path"])
+            if os.path.isabs(rel) or rel.startswith(".."):
+                raise RuntimeError(
+                    f"{self.node_name}: refusing listed path {item['path']!r}"
+                    " escaping the bundle directory")
+            total += self.fetch_file(recording_id, item["path"],
+                                     os.path.join(dest_dir, rel))
+        return {"files": len(files), "bytes": total}
 
     # -- Trace resources (ref: utils/trace.go:340-848 CreateTrace/
     #    SetTraceOperation/getTraceListFromOptions, over agent RPCs) --------

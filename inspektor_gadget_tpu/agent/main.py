@@ -36,6 +36,10 @@ def main(argv=None) -> int:
                     help="persist live sketch state here each interval; "
                          "resumed (merged) after restart")
     sp.add_argument("--checkpoint-interval", type=float, default=30.0)
+    sp.add_argument("--capture-dir", default="",
+                    help="base directory for capture recordings "
+                         "(StartRecording RPC / ig-tpu record start); "
+                         "default $IG_CAPTURE_DIR or ~/.ig-tpu/capture")
     sp.add_argument("--metrics-addr", default="",
                     help="serve Prometheus text metrics on host:port "
                          "(e.g. :9100); off by default")
@@ -187,6 +191,9 @@ def _serve_loop(args) -> int:
         f"/tmp/igtpu-flight-{args.node_name}.json"
     if flight_path != "off":
         install_crash_handlers(flight_path, signals=())
+    if args.capture_dir:
+        from ..capture import RECORDINGS
+        RECORDINGS.set_base_dir(args.capture_dir)
     # bind BEFORE installing hooks: a prestart config pointing at a socket
     # nobody serves stalls every container creation on the host
     server, _agent = serve(args.listen, node_name=args.node_name,
@@ -265,6 +272,10 @@ def _serve_loop(args) -> int:
         if _agent.metrics_server is not None:
             _agent.metrics_server.stop()
         _agent.stop_checkpointer()
+        # seal any armed recordings: a clean SIGTERM must not leave
+        # unsealed journals for the torn-tail reader to account
+        from ..capture import RECORDINGS
+        RECORDINGS.stop_all()
         if installer is not None:
             installer.uninstall()
         server.stop(grace=2.0)

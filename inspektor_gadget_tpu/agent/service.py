@@ -410,6 +410,120 @@ class AgentServer:
         h, _ = wire.decode_msg(request)
         return wire.encode_msg({"deleted": self.traces.delete(h.get("name", ""))})
 
+    # -- capture/recording lifecycle RPCs (capture/) ------------------------
+
+    def start_recording(self, request: bytes, context) -> bytes:
+        """Arm the node-wide recording: every running and future gadget
+        run on this agent tees its batches/summaries/alerts into
+        journals under the recording directory until StopRecording."""
+        _tm_rpc.labels(method="StartRecording").inc()
+        h, _ = wire.decode_msg(request)
+        from ..capture import RECORDINGS
+        opts = {k: v for k, v in (h.get("opts") or {}).items()
+                if k in ("max_segment_bytes", "max_segment_age",
+                         "retention_bytes", "retention_segments")}
+        rid = h.get("recording_id", "")
+        existing = RECORDINGS.get(rid) if rid else None
+        if existing is not None:
+            # idempotent for fan-out retries and in-process agent fleets
+            # sharing one manager: arming an armed recording is a no-op
+            return wire.encode_msg({"ok": True, "recording_id": existing.id,
+                                    "dir": existing.path, "already": True,
+                                    "node": self.node_name})
+        try:
+            # always the manager's base area (--capture-dir): a client-
+            # chosen base would be invisible to ListRecordings/Fetch,
+            # which resolve under the same default
+            rec = RECORDINGS.start(rid, **opts)
+        except (ValueError, OSError) as e:
+            return wire.encode_msg({"error": str(e)})
+        return wire.encode_msg({"ok": True, "recording_id": rec.id,
+                                "dir": rec.path, "node": self.node_name})
+
+    def stop_recording(self, request: bytes, context) -> bytes:
+        _tm_rpc.labels(method="StopRecording").inc()
+        h, _ = wire.decode_msg(request)
+        import os
+        from ..capture import RECORDINGS
+        from ..capture.manager import RECORDING_META
+        rid = h.get("recording_id", "")
+        try:
+            meta = RECORDINGS.stop(rid)
+        except KeyError as e:
+            # a peer RPC in the same process (in-process fleet) may have
+            # stopped it already: a sealed recording on disk is success,
+            # a never-started id is the error
+            try:
+                done = os.path.join(RECORDINGS.recording_dir(rid),
+                                    RECORDING_META)
+            except ValueError as bad:
+                return wire.encode_msg({"error": str(bad)})
+            if rid and os.path.exists(done):
+                return wire.encode_msg({"ok": True, "already": True,
+                                        "node": self.node_name})
+            return wire.encode_msg({"error": str(e)})
+        return wire.encode_msg({"ok": True, "recording": meta,
+                                "node": self.node_name})
+
+    def list_recordings(self, request: bytes, context) -> bytes:
+        """Active + on-disk recordings; with recording_id set, also the
+        relative file list (the fetch fan-out's download manifest)."""
+        _tm_rpc.labels(method="ListRecordings").inc()
+        h, _ = wire.decode_msg(request)
+        from ..capture import RECORDINGS
+        msg: dict = {"node": self.node_name,
+                     "recordings": RECORDINGS.list()}
+        rid = h.get("recording_id", "")
+        if rid:
+            import os
+            try:
+                root = RECORDINGS.recording_dir(rid)
+            except ValueError as e:
+                msg["error"] = str(e)
+                return wire.encode_msg(msg)
+            files = []
+            if os.path.isdir(root):
+                for base, _dirs, names in os.walk(root):
+                    for name in sorted(names):
+                        p = os.path.join(base, name)
+                        files.append({"path": os.path.relpath(p, root),
+                                      "bytes": os.path.getsize(p)})
+            else:
+                msg["error"] = f"no recording {rid!r} on {self.node_name}"
+            msg["files"] = sorted(files, key=lambda f: f["path"])
+        return wire.encode_msg(msg)
+
+    def fetch_segment(self, request: bytes, context) -> bytes:
+        """Chunked download of one recording file (segments, manifests);
+        stays under gRPC's 4 MiB default message cap via offset+limit."""
+        _tm_rpc.labels(method="FetchSegment").inc()
+        h, _ = wire.decode_msg(request)
+        import os
+        from ..capture import RECORDINGS
+        rid = h.get("recording_id", "")
+        rel = h.get("file", "")
+        norm = os.path.normpath(rel)
+        if not rid or not rel or norm.startswith("..") or \
+                os.path.isabs(norm):
+            return wire.encode_msg(
+                {"error": f"bad fetch request ({rid!r}, {rel!r})"})
+        try:
+            path = os.path.join(RECORDINGS.recording_dir(rid), norm)
+        except ValueError as e:
+            return wire.encode_msg({"error": str(e)})
+        offset = max(int(h.get("offset", 0)), 0)
+        limit = min(max(int(h.get("limit", 1 << 20)), 1), 2 << 20)
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read(limit)
+        except OSError as e:
+            return wire.encode_msg({"error": f"{rel}: {e.strerror or e}"})
+        return wire.encode_msg(
+            {"ok": True, "file": rel, "offset": offset, "size": size,
+             "eof": offset + len(chunk) >= size}, chunk)
+
     # -- dump-state debug RPC (ref: gadgettracermanager.go DumpState :204) --
 
     def dump_state(self, request: bytes, context) -> bytes:
@@ -524,6 +638,13 @@ def serve(address: str = "unix:///tmp/igtpu-agent.sock",
         "RemoveContainer": _method(agent.remove_container, "unary",
                                    "RemoveContainer"),
         "DumpState": _method(agent.dump_state, "unary", "DumpState"),
+        "StartRecording": _method(agent.start_recording, "unary",
+                                  "StartRecording"),
+        "StopRecording": _method(agent.stop_recording, "unary",
+                                 "StopRecording"),
+        "ListRecordings": _method(agent.list_recordings, "unary",
+                                  "ListRecordings"),
+        "FetchSegment": _method(agent.fetch_segment, "unary", "FetchSegment"),
         "ApplyTrace": _method(agent.apply_trace, "unary", "ApplyTrace"),
         "GetTrace": _method(agent.get_trace, "unary", "GetTrace"),
         "ListTraces": _method(agent.list_traces, "unary", "ListTraces"),

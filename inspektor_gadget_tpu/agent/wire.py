@@ -26,7 +26,25 @@ EV_BATCH_NPZ = 4        # columnar EventBatch as npz
 EV_SUMMARY = 5          # sketch summary (mergeable state digest)
 EV_CONTROL_ACK = 6
 EV_ALERT = 7            # alert lifecycle transition (alerts/engine.py)
+EV_JOURNAL_MARK = 8     # capture-journal lifecycle marker (capture/)
 EV_LOG_SHIFT = 16       # type >> 16 = severity when nonzero
+
+# The one registry every EV_* wire id must appear in. Stream decoding,
+# the capture journal, and docs all key off these numbers, so a silent
+# collision (two planes hand-assigning the same id) corrupts decode far
+# from the assignment; tools/check_wire_ids.py (tier-1 via
+# tests/test_wire_ids.py) fails the suite on an unregistered constant, a
+# duplicate id, or an id that would collide with the severity bits.
+WIRE_EVENT_IDS: dict[str, int] = {
+    "EV_PAYLOAD_JSON": EV_PAYLOAD_JSON,
+    "EV_PAYLOAD_ARRAY": EV_PAYLOAD_ARRAY,
+    "EV_RESULT": EV_RESULT,
+    "EV_BATCH_NPZ": EV_BATCH_NPZ,
+    "EV_SUMMARY": EV_SUMMARY,
+    "EV_CONTROL_ACK": EV_CONTROL_ACK,
+    "EV_ALERT": EV_ALERT,
+    "EV_JOURNAL_MARK": EV_JOURNAL_MARK,
+}
 
 
 def encode_msg(header: dict, payload: bytes = b"") -> bytes:

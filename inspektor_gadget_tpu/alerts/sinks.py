@@ -8,16 +8,15 @@ bolt on pagers/webhooks without touching the engine. Two built-ins:
   client-side even without the typed EV_ALERT path).
 - WebhookFileSink: appends each transition as one JSON line to a file —
   the webhook stand-in tests and air-gapped deployments assert against
-  (O_APPEND single-write, same crash-safety stance as the perf ledger).
+  (the shared utils/journal.py append + torn-tail-read discipline).
 """
 
 from __future__ import annotations
 
-import json
 import logging
-import os
 from typing import Protocol, runtime_checkable
 
+from ..utils.journal import append_line, read_jsonl
 from .engine import AlertEvent
 
 _SEV_LEVEL = {"info": logging.INFO, "warning": logging.WARNING,
@@ -53,28 +52,12 @@ class WebhookFileSink:
         self.path = path
 
     def emit(self, event: AlertEvent) -> None:
-        line = json.dumps(event.to_dict(), separators=(",", ":")) + "\n"
-        fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
-                     0o644)
-        try:
-            os.write(fd, line.encode())
-        finally:
-            os.close(fd)
+        append_line(self.path, event.to_dict())
 
     @staticmethod
     def read(path: str) -> list[dict]:
         """Read back a sink file, tolerating a crash-truncated tail."""
-        out: list[dict] = []
         try:
-            with open(path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        out.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        break  # torn tail — everything before it is good
+            return read_jsonl(path, on_bad="stop").records
         except OSError:
-            pass
-        return out
+            return []

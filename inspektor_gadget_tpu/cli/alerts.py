@@ -38,13 +38,20 @@ def add_alerts_parser(sub) -> None:
     rp.set_defaults(func=cmd_alerts_rules)
 
     tp = asub.add_parser("test", help="dry-run rules against recorded "
-                         "summaries (JSON lines)")
+                         "traffic (a capture journal, or the deprecated "
+                         "JSON-lines summary format)")
     tp.add_argument("--file", required=True, help="YAML/JSON rule document")
-    tp.add_argument("--summaries", required=True,
-                    help="JSON-lines file of summary dicts, or '-' (stdin)")
+    tp.add_argument("--journal", default="",
+                    help="capture journal/recording/bundle to replay the "
+                         "rules against (timing comes from the recorded "
+                         "clock)")
+    tp.add_argument("--summaries", default="",
+                    help="DEPRECATED: JSON-lines file of summary dicts, "
+                         "or '-' (stdin); prefer --journal")
     tp.add_argument("--interval", type=float, default=1.0,
                     help="simulated seconds between summaries "
-                         "(drives for/cooldown timing)")
+                         "(--summaries path only; journals carry their "
+                         "own clock)")
     tp.set_defaults(func=cmd_alerts_test)
 
 
@@ -118,6 +125,15 @@ def cmd_alerts_test(args) -> int:
     except RuleError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if bool(args.journal) == bool(args.summaries):
+        print("error: set exactly one of --journal or --summaries",
+              file=sys.stderr)
+        return 2
+    if args.journal:
+        return _test_against_journal(args)
+    print("warning: --summaries is a deprecated read path; record a "
+          "capture journal and use --journal (see docs/capture.md)",
+          file=sys.stderr)
     try:
         raw = (sys.stdin.read() if args.summaries == "-"
                else open(args.summaries, encoding="utf-8").read())
@@ -152,4 +168,51 @@ def cmd_alerts_test(args) -> int:
         now += args.interval
     print(f"{len(summaries)} summaries, {transitions} transition(s), "
           f"{len(engine.firing())} still firing")
+    return 0
+
+
+def _test_against_journal(args) -> int:
+    """Dry-run a rule file against recorded journals: the journal's
+    EV_SUMMARY records drive a private engine on the RECORDED clock, so
+    for/cooldown decisions match what the rules would have done live."""
+    import os
+
+    from ..agent import wire
+    from ..capture import JournalReader, ReplayClock, iter_journals
+    from ..alerts.rules import load_rules_file as _load
+    rules = _load(args.file)
+    if not os.path.isdir(args.journal):
+        print(f"error: {args.journal}: not a directory", file=sys.stderr)
+        return 2
+    journals = list(iter_journals(args.journal))
+    if not journals:
+        print(f"error: no journals under {args.journal}", file=sys.stderr)
+        return 2
+    total_summaries = 0
+    total_transitions = 0
+    still_firing = 0
+    for jpath in journals:
+        reader = JournalReader(jpath)
+        engine = AlertEngine(rules, node="dry-run", dry_run=True)
+        clock = ReplayClock()
+        n = 0
+        for header, payload in reader.records(types=(wire.EV_SUMMARY,)):
+            clock.advance_to(float(header.get("ts", 0.0)))
+            summary = wire.decode_summary(header, payload)
+            for ev in engine.observe(summary, now=clock.now()):
+                total_transitions += 1
+                print(f"{os.path.basename(jpath)} epoch "
+                      f"{summary.get('epoch')}: {ev.rule} -> {ev.transition}"
+                      + (f" key={ev.key}" if ev.key else "")
+                      + f" (value={ev.value:.6g}, "
+                        f"threshold={ev.threshold:g})")
+            n += 1
+        for loss in reader.losses:
+            print(f"warning: {jpath}: torn tail dropped "
+                  f"({loss.reason}, {loss.dropped_bytes} bytes)",
+                  file=sys.stderr)
+        total_summaries += n
+        still_firing += len(engine.firing())
+    print(f"{len(journals)} journal(s), {total_summaries} summaries, "
+          f"{total_transitions} transition(s), {still_firing} still firing")
     return 0

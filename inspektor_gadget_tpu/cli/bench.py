@@ -46,6 +46,10 @@ def add_bench_parser(sub) -> None:
                     help="seconds the probe retries are spread over")
     rp.add_argument("--trace-out", default="",
                     help="also write a Chrome trace of the run here")
+    rp.add_argument("--replay", default="",
+                    help="feed the harness a capture journal instead of "
+                         "the synthetic source (reproducible input; the "
+                         "journal digest lands in the record provenance)")
     rp.add_argument("--no-ledger", action="store_true",
                     help="print the record without appending it")
     rp.add_argument("-o", "--output", default="json",
@@ -92,8 +96,9 @@ def cmd_bench_run(args) -> int:
             probe_timeout=args.probe_timeout,
             probe_attempts=args.probe_attempts,
             probe_horizon=args.probe_horizon,
-            trace_out=args.trace_out or None)
-    except ValueError as e:
+            trace_out=args.trace_out or None,
+            replay=args.replay or None)
+    except (ValueError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     if not args.no_ledger:

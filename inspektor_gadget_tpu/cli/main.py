@@ -112,6 +112,9 @@ def build_parser() -> argparse.ArgumentParser:
     _remote_arg(frp)
     frp.add_argument("--node", default=argparse.SUPPRESS,
                      help="restrict to one node")
+    frp.add_argument("--from-dump", default=argparse.SUPPRESS,
+                     help="read a crash dump file instead of live agents "
+                          "(tolerates crash-truncated dumps)")
     frp.set_defaults(func=cmd_debug_flight)
 
     dtp = bsub.add_parser("trace", help="distributed-trace verbs")
@@ -135,6 +138,11 @@ def build_parser() -> argparse.ArgumentParser:
     # rule dry-runs against recorded summaries
     from .alerts import add_alerts_parser
     add_alerts_parser(sub)
+
+    # capture/replay plane: recording lifecycle + deterministic replay
+    from .record import add_record_parser, add_replay_parser
+    add_record_parser(sub)
+    add_replay_parser(sub)
 
     vp = sub.add_parser("version", help="print version")
     vp.set_defaults(func=lambda a: (print(_version()), 0)[1])
@@ -358,6 +366,17 @@ def cmd_debug_flight(args) -> int:
     """ref: the flight-recorder analogue of `kubectl-gadget debug` — the
     agent's crash-safe ring of recent spans/logs/errors over DumpState."""
     from ..agent.client import AgentClient
+    dump_path = getattr(args, "from_dump", "")
+    if dump_path:
+        from ..telemetry.tracing import load_dump
+        doc, err = load_dump(dump_path)
+        if doc is None:
+            print(f"error: {err}", file=sys.stderr)
+            return 1
+        if err:
+            print(f"warning: {err}", file=sys.stderr)
+        print(json.dumps({dump_path: doc}, indent=2, default=str))
+        return 0
     try:
         targets = _debug_targets(args)
     except ParamError as e:

@@ -264,6 +264,36 @@ def _probe_container_runtime() -> Window:
         return Window("container_runtime", False, repr(e))
 
 
+def _probe_capture_dir() -> Window:
+    """Capture-plane row: is the recording area writable, and how much
+    does it already hold? A node that cannot journal loses its replay
+    evidence exactly when an incident makes it wanted."""
+    try:
+        import tempfile
+
+        from .capture import capture_base_dir
+        from .capture.journal import dir_stats
+        base = capture_base_dir()
+        os.makedirs(base, exist_ok=True)
+        with tempfile.NamedTemporaryFile(dir=base, prefix=".doctor-"):
+            pass
+        segments, usage = dir_stats(base)
+        try:
+            st = os.statvfs(base)
+            free = st.f_bavail * st.f_frsize
+            free_s = f", {free / (1 << 30):.1f} GiB free"
+        except OSError:
+            free_s = ""
+        return Window("capture_dir", True,
+                      f"{base} writable ({usage / (1 << 20):.1f} MiB in "
+                      f"{segments} segment(s){free_s})")
+    except OSError as e:
+        return Window("capture_dir", False,
+                      f"capture dir unwritable: {e.strerror or e}")
+    except Exception as e:  # noqa: BLE001
+        return Window("capture_dir", False, repr(e))
+
+
 def _probe_mountinfo() -> Window:
     try:
         with open("/proc/self/mountinfo") as f:
@@ -288,7 +318,7 @@ _PROBES = (
     _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
     _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,
-    _probe_sigtrace, _probe_container_runtime,
+    _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
 )
 
 
@@ -351,6 +381,8 @@ _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
                      "connection-churn fallback"),
     ("top", "block-io"): ("procfs", "", "/proc/diskstats deltas"),
     ("top", "sketch"): ("native_lib", "", "capture-plane self-observation"),
+    ("top", "recordings"): ("capture_dir", "",
+                            "recording lifecycle + journal disk usage"),
     ("top", "self"): ("native_lib", "", "native source self-stats"),
     ("snapshot", "process"): ("procfs", "", "procfs collector"),
     ("snapshot", "socket"): ("procfs", "", "procfs collector"),

@@ -30,7 +30,13 @@ class Alerts(Operator):
     name = "alerts"
 
     def dependencies(self) -> list[str]:
-        return []
+        # capture must instantiate BEFORE alerts so it tears down AFTER
+        # (post_gadget_run runs in reverse): the engine's end-of-run
+        # resolves flow through ctx.extra["on_alert_event"] at close(),
+        # and the capture operator's journal writers must still be open
+        # to record them — otherwise a recorded run and its replay
+        # disagree on the final transitions
+        return ["capture"]
 
     def can_operate_on(self, desc: GadgetDesc) -> bool:
         return True  # anything the sketch plane can ride, alerts can
@@ -78,6 +84,10 @@ class AlertsInstance(OperatorInstance):
         if webhook:
             sinks.append(WebhookFileSink(webhook))
         trace_ctx = ctx.extra.get("trace_ctx")
+        # injectable evaluation clock (capture replay drives the engine on
+        # the RECORDED timeline, so debounce/cooldown decisions reproduce
+        # exactly); None → the engine's own monotonic clock
+        self._clock = ctx.extra.get("alerts_clock")
         self.engine = AlertEngine(
             rules,
             node=ctx.extra.get("node") or TRACER.node or "local",
@@ -88,6 +98,9 @@ class AlertsInstance(OperatorInstance):
             # read lazily: the agent wires its EV_ALERT push into
             # ctx.extra after operators instantiate on some paths
             on_event=lambda ev: self._push(ev),
+            # dry-run replays (alerts test --journal) stay out of the
+            # process-wide table, telemetry, and flight recorder
+            dry_run=bool(ctx.extra.get("alerts_dry_run")),
         )
         # rules with no sketch plane behind them would never evaluate —
         # say so loudly instead of letting the silence read as "healthy"
@@ -104,7 +117,9 @@ class AlertsInstance(OperatorInstance):
         prev = ctx.extra.get("on_sketch_summary")
 
         def hook(summary):
-            self.engine.observe(summary)
+            self.engine.observe(
+                summary,
+                now=self._clock() if self._clock is not None else None)
             if prev is not None:
                 prev(summary)
 
@@ -120,7 +135,8 @@ class AlertsInstance(OperatorInstance):
         # (gauge, stores, sinks, and the stream all see it) — a stopped
         # run must not read as a live incident forever
         if self.engine is not None:
-            self.engine.close()
+            self.engine.close(
+                now=self._clock() if self._clock is not None else None)
 
 
 register(Alerts())

@@ -447,7 +447,12 @@ class TpuSketchInstance(OperatorInstance):
 
     def post_gadget_run(self) -> None:
         if self.enabled:
-            self.harvest()
+            # replay runs harvest ONLY at the recorded EV_SUMMARY
+            # boundaries (capture/replay.py) — a teardown harvest here
+            # would mint an epoch the original run never had and break
+            # the digest-sequence determinism contract
+            if not self.ctx.extra.get("replay"):
+                self.harvest()
             self._stats.unregister()
             if _ckpt_dir is not None:
                 # shutdown save stays best-effort, but failures are now

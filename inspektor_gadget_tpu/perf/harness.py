@@ -123,8 +123,16 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                 probe_attempts: int | None = None,
                 probe_horizon: float | None = None,
                 trace_out: str | None = None,
+                replay: str | None = None,
                 extra_provenance_probe: dict | None = None) -> dict:
     """Run one harness config; returns a validated PerfRecord dict.
+
+    `replay` points the host side at a capture journal instead of the
+    synthetic source: the measured input becomes reproducible
+    input-for-input (the recorded batch sequence, cycled through the
+    window) and the journal's content digest lands in the record's
+    provenance, so two records claiming the same replay input can be
+    checked against each other.
 
     The caller decides whether it lands in the ledger (cli/bench.py
     appends by default; tests pass their own tmp path)."""
@@ -152,7 +160,17 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     actual = jax.devices()[0].platform
 
     batch_n = cfg["batch"]
-    src = PySyntheticSource(seed=42, vocab=5000, batch_size=batch_n)
+    replay_src = None
+    if replay:
+        from ..capture.replay import ReplaySource
+        replay_src = ReplaySource(replay, cycle=True)
+        if not len(replay_src):
+            raise ValueError(f"{replay}: journal carries no batches to "
+                             "replay through the harness")
+        src = replay_src
+        batch_n = max(b.capacity for b in replay_src.batches)
+    else:
+        src = PySyntheticSource(seed=42, vocab=5000, batch_size=batch_n)
 
     def new_bundle():
         return bundle_init(depth=cfg["depth"], log2_width=cfg["log2_width"],
@@ -165,14 +183,26 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                             "batch": batch_n}) as run_span:
         clock = _StageClock(run_span.context)
 
-        # warm: compile + source ramp, outside every measured window
+        # warm: compile + source ramp, outside every measured window.
+        # Replay journals may carry heterogeneous batch shapes, and each
+        # distinct shape is a fresh XLA compile — warm them ALL here or
+        # the compile lands inside the measured window (the exact
+        # non-reproducibility --replay exists to eliminate)
         bundle = new_bundle()
-        warm = src.generate(batch_n)
-        wk = jnp.asarray(_fold32(np.asarray(warm.cols["key_hash"])))
-        wm = jnp.asarray(warm.mask())
-        for _ in range(2):
-            bundle = bundle_update_jit(bundle, wk, wk, wk, wm)
+        if replay_src is not None:
+            warm_batches = list({b.capacity: b
+                                 for b in replay_src.batches}.values())
+        else:
+            warm_batches = [src.generate(batch_n)]
+        for warm in warm_batches:
+            wk = jnp.asarray(_fold32(np.asarray(warm.cols["key_hash"])))
+            wm = jnp.asarray(warm.mask())
+            for _ in range(2):
+                bundle = bundle_update_jit(bundle, wk, wk, wk, wm)
         jax.block_until_ready(bundle.events)
+        if replay_src is not None:
+            replay_src.reset()  # measure the recorded sequence from 0
+            bundle = new_bundle()
 
         steps = 0
         events = 0
@@ -256,6 +286,13 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
         probe.update(extra_provenance_probe)
     prov = build_provenance(actual, bool(acquired.get("degraded")),
                             probe=probe)
+    extra_fields: dict = {}
+    if replay_src is not None:
+        # the journal digest IS part of the number's meaning: same
+        # config + same digest → directly comparable records
+        prov["replay"] = {"journal": replay, "digest": replay_src.digest,
+                          "batches": len(replay_src)}
+        extra_fields["replay_digest"] = replay_src.digest
     rec = make_record(
         config=f"harness.{config}",
         metric="sketch_ingest_throughput_e2e",
@@ -267,7 +304,7 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
         extra={"batch": batch_n, "steps": steps, "events": events,
                "drops": drops, "elapsed_s": round(elapsed, 3),
                "window_s": window, "trace_id": trace_id,
-               "requested_platform": platform},
+               "requested_platform": platform, **extra_fields},
         trace_file=trace_file,
     )
     log.info("harness %s: %.1f ev/s on %s%s (%d events, %d steps)",

@@ -7,12 +7,11 @@ compare` baselines against it; `tools/check_perf_claims.py` checks doc
 numbers against it.
 
 Append discipline: the record is validated first (a ledger line that
-fails the schema is worse than no line), serialized to ONE compact line,
-and written on an O_APPEND fd — normally one `os.write`, which POSIX
-makes atomic between processes, so concurrent bench runs cannot
-interleave bytes (a rare short write is completed in a loop or raised,
-never reported as success). Reads tolerate a crash-truncated final line
-(counted, skipped) — the flight-recorder stance applied to perf history.
+fails the schema is worse than no line), then written through the shared
+utils/journal.py atomic-append + torn-tail-tolerant-read discipline (one
+O_APPEND write per line; reads skip-and-report unusable lines) — the
+same recovery logic the alert webhook sink and the capture plane use,
+kept in exactly one place.
 """
 
 from __future__ import annotations
@@ -22,6 +21,7 @@ import json
 import os
 from typing import Iterable
 
+from ..utils.journal import append_line, read_jsonl
 from .schema import SCHEMA_ID, make_record, validate_record
 
 DEFAULT_LEDGER = os.path.join("benchmarks", "ledger", "PERF.jsonl")
@@ -44,20 +44,7 @@ def append_record(rec: dict, path: str | None = None) -> str:
         raise ValueError("refusing to append invalid PerfRecord: "
                          + "; ".join(errors))
     p = ledger_path(path)
-    d = os.path.dirname(p)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    line = json.dumps(rec, sort_keys=True, separators=(",", ":")) + "\n"
-    buf = line.encode("utf-8")
-    fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-    try:
-        while buf:  # a short write must not report success on a torn line
-            n = os.write(fd, buf)
-            if n <= 0:
-                raise OSError(f"short write appending to {p}")
-            buf = buf[n:]
-    finally:
-        os.close(fd)
+    append_line(p, rec)
     return p
 
 
@@ -65,29 +52,15 @@ def read_ledger(path: str | None = None) -> LedgerRead:
     """All parseable, schema-valid records in append order. Unusable
     lines are reported, not fatal: a crash mid-append must not take the
     whole history down with it."""
-    p = ledger_path(path)
-    records: list[dict] = []
-    skipped: list[str] = []
-    if not os.path.exists(p):
-        return LedgerRead(records, skipped)
-    with open(p, encoding="utf-8") as f:
-        for i, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError as e:
-                skipped.append(f"line {i}: unparseable ({e.msg})")
-                continue
-            errors = validate_record(rec)
-            if errors:
-                skipped.append(f"line {i}: invalid ({errors[0]}"
-                               + (f" +{len(errors) - 1} more" if len(errors) > 1
-                                  else "") + ")")
-                continue
-            records.append(rec)
-    return LedgerRead(records, skipped)
+    def _validate(rec: dict) -> str | None:
+        errors = validate_record(rec)
+        if not errors:
+            return None
+        return errors[0] + (f" +{len(errors) - 1} more" if len(errors) > 1
+                            else "")
+
+    jr = read_jsonl(ledger_path(path), on_bad="skip", validate=_validate)
+    return LedgerRead(jr.records, jr.skipped)
 
 
 # ---------------------------------------------------------------------------

@@ -84,6 +84,66 @@ class GrpcRuntime(Runtime):
                 continue
         return super().get_catalog()
 
+    # -- recording lifecycle fan-out (capture/) -----------------------------
+
+    def _fanout_unary(self, fn, nodes=None) -> tuple[dict, dict]:
+        """(per-node results, per-node errors) — the per-node isolation
+        contract every fan-out verb follows (runtime.go:42-79)."""
+        results: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+        for node in (nodes or self.targets):
+            try:
+                results[node] = fn(self._client(node))
+            except Exception as e:  # noqa: BLE001 — per-node isolation
+                errors[node] = str(e)
+        return results, errors
+
+    def start_recording(self, recording_id: str,
+                        opts: dict | None = None) -> tuple[dict, dict]:
+        return self._fanout_unary(
+            lambda c: c.start_recording(recording_id, opts=opts))
+
+    def stop_recording(self, recording_id: str) -> tuple[dict, dict]:
+        return self._fanout_unary(lambda c: c.stop_recording(recording_id))
+
+    def list_recordings(self, recording_id: str = "") -> tuple[dict, dict]:
+        return self._fanout_unary(lambda c: c.list_recordings(recording_id))
+
+    def fetch_recording(self, recording_id: str, dest_dir: str) -> dict:
+        """Pull every node's journals for one recording into a single
+        client-side bundle:
+
+            <dest_dir>/
+              bundle.json          # which nodes, how much, what failed
+              <node>/<journal>/... # each node's recording dir, verbatim
+
+        Per-node errors are recorded in the bundle manifest, never
+        fatal — a crashed node's journals are exactly the ones worth
+        fetching from its peers."""
+        import json
+        import os
+        import time as _time
+        per_node: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+        for node in self.targets:
+            try:
+                per_node[node] = self._client(node).fetch_recording(
+                    recording_id, os.path.join(dest_dir, node))
+            except Exception as e:  # noqa: BLE001 — per-node isolation
+                errors[node] = str(e)
+        bundle = {
+            "schema": "ig-tpu/capture-bundle/v1",
+            "recording_id": recording_id,
+            "fetched_ts": _time.time(),
+            "nodes": per_node,
+            "errors": errors,
+        }
+        os.makedirs(dest_dir, exist_ok=True)
+        with open(os.path.join(dest_dir, "bundle.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(bundle, f, sort_keys=True, indent=2)
+        return bundle
+
     def run_gadget(
         self,
         ctx: GadgetContext,

@@ -356,6 +356,35 @@ class FlightRecorder:
             self._errors.clear()
 
 
+def load_dump(path: str) -> tuple[dict | None, str]:
+    """Post-mortem read of a flight-recorder dump: (snapshot, "") or
+    (None, why). Routed through the shared utils/journal tolerant read —
+    a dump truncated by the very crash it documents (or a leftover
+    .tmp from an interrupted atomic write) is reported, never raised."""
+    from ..utils.journal import read_json_file
+    doc, err = read_json_file(path)
+    if doc is None:
+        # an interrupted atomic dump leaves <path>.tmp.<pid>; the newest
+        # one is the best surviving evidence. A tmp can vanish between
+        # glob and stat (the dumper's os.replace landing) — never raise
+        # from a helper whose contract is reported-not-raised
+        import glob
+
+        def _mtime(p: str) -> float:
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0
+
+        tmps = sorted(glob.glob(f"{path}.tmp.*"), key=_mtime)
+        if tmps:
+            doc2, err2 = read_json_file(tmps[-1])
+            if doc2 is not None:
+                return doc2, f"recovered from {tmps[-1]} ({err})"
+        return None, err
+    return doc, ""
+
+
 class FlightRecorderHandler(logging.Handler):
     """logging.Handler feeding the flight recorder. Picks up `run_id` /
     `trace_id` attrs (StreamLogger threads them onto remote records) so

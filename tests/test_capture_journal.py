@@ -1,0 +1,387 @@
+"""Capture-plane unit tier: segment framing, torn-tail recovery,
+rotation + retention GC (including under concurrent writers), manifest
+provenance round-trip, range reads, digests, the recording manager, and
+the shared utils/journal reader all three planes now sit on."""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+
+import pytest
+
+from inspektor_gadget_tpu.agent import wire
+from inspektor_gadget_tpu.capture import (
+    RECORDINGS,
+    JournalReader,
+    JournalWriter,
+    build_manifest,
+    is_journal,
+    summary_digest,
+)
+from inspektor_gadget_tpu.capture.journal import INDEX, scan_segment
+from inspektor_gadget_tpu.utils.journal import append_line, read_json_file, read_jsonl
+
+
+def _write(tmp_path, name="j", n=5, **kw):
+    w = JournalWriter(str(tmp_path / name), **kw)
+    for i in range(n):
+        w.append(wire.EV_BATCH_NPZ, {"count": i + 1}, f"payload-{i}".encode())
+    return w
+
+
+# -- shared utils/journal reader (the factored-out satellite) ---------------
+
+def test_read_jsonl_stop_vs_skip(tmp_path):
+    p = str(tmp_path / "x.jsonl")
+    append_line(p, {"a": 1})
+    with open(p, "a") as f:
+        f.write("{broken}\n")
+    append_line(p, {"b": 2})
+    stop = read_jsonl(p, on_bad="stop")
+    assert [r for r in stop.records] == [{"a": 1}] and stop.skipped
+    skip = read_jsonl(p, on_bad="skip")
+    assert skip.records == [{"a": 1}, {"b": 2}] and skip.skipped
+
+
+def test_read_jsonl_validate_and_missing(tmp_path):
+    p = str(tmp_path / "y.jsonl")
+    append_line(p, {"v": 1})
+    append_line(p, {"v": -1})
+    r = read_jsonl(p, on_bad="skip",
+                   validate=lambda rec: "neg" if rec["v"] < 0 else None)
+    assert r.records == [{"v": 1}] and "invalid (neg)" in r.skipped[0]
+    assert read_jsonl(str(tmp_path / "absent.jsonl")).records == []
+
+
+def test_flight_recorder_dump_reads_tolerate_truncation(tmp_path):
+    from inspektor_gadget_tpu.telemetry.tracing import RECORDER, load_dump
+    p = str(tmp_path / "flight.json")
+    RECORDER.dump(p)
+    doc, err = load_dump(p)
+    assert doc is not None and not err and "spans" in doc
+    # crash-truncated dump: reported, not raised
+    blob = open(p).read()
+    open(p, "w").write(blob[: len(blob) // 2])
+    doc, err = load_dump(p)
+    assert doc is None and "truncated" in err
+    # an interrupted atomic write leaves .tmp.<pid>; recovery reads it
+    open(f"{p}.tmp.12345", "w").write(blob)
+    doc, err = load_dump(p)
+    assert doc is not None and "recovered" in err
+
+
+def test_webhook_sink_and_ledger_share_the_reader(tmp_path):
+    # the two pre-existing consumers still read through their old API
+    from inspektor_gadget_tpu.alerts import WebhookFileSink
+    from inspektor_gadget_tpu.alerts.engine import AlertEvent
+    p = str(tmp_path / "hook.jsonl")
+    WebhookFileSink(p).emit(AlertEvent(rule="r", severity="warning",
+                                       kind="threshold",
+                                       transition="firing"))
+    with open(p, "a") as f:
+        f.write('{"torn": ')
+    events = WebhookFileSink.read(p)
+    assert len(events) == 1 and events[0]["rule"] == "r"
+
+
+# -- framing + torn tails ---------------------------------------------------
+
+def test_journal_roundtrip_types_and_payloads(tmp_path):
+    w = _write(tmp_path, n=3)
+    w.mark("run-end", run_id="x")
+    w.close()
+    r = JournalReader(str(tmp_path / "j"))
+    recs = list(r.records())
+    assert [h["type"] for h, _ in recs] == [wire.EV_BATCH_NPZ] * 3 + [
+        wire.EV_JOURNAL_MARK]
+    assert [h["seq"] for h, _ in recs] == [1, 2, 3, 4]
+    assert recs[0][1] == b"payload-0"
+    assert recs[3][0]["mark"] == "run-end"
+    assert not r.losses
+
+
+@pytest.mark.parametrize("tear", ["header", "body", "crc"])
+def test_torn_tail_dropped_and_accounted(tmp_path, tear):
+    w = _write(tmp_path, name=f"t-{tear}", n=4)
+    seg = w._active_path()
+    w.close()
+    data = open(seg, "rb").read()
+    if tear == "header":
+        open(seg, "ab").write(b"\x20\x00")          # half a length prefix
+    elif tear == "body":
+        zp = zlib.compress(b"never-finished")
+        frame = (len(zp).to_bytes(4, "little")
+                 + (zlib.crc32(zp) & 0xFFFFFFFF).to_bytes(4, "little") + zp)
+        open(seg, "ab").write(frame[: len(frame) - 3])
+    else:  # flip a payload byte: crc must catch it
+        mutated = bytearray(data)
+        mutated[-1] ^= 0xFF
+        open(seg, "wb").write(bytes(mutated))
+    r = JournalReader(os.path.dirname(seg))
+    recs = list(r.records())
+    assert len(recs) == (4 if tear != "crc" else 3)
+    assert len(r.losses) == 1
+    loss = r.losses[0]
+    assert loss.dropped_bytes > 0
+    assert loss.reason  # named, not silent
+
+
+def test_reopen_after_crash_truncates_tear_and_continues_seq(tmp_path):
+    w = _write(tmp_path, name="re", n=3)
+    seg = w._active_path()
+    # crash: no close(); a torn frame sits at the tail
+    open(seg, "ab").write(b"\x99\x00\x00\x00junk")
+    w2 = JournalWriter(str(tmp_path / "re"))
+    s = w2.append(wire.EV_JOURNAL_MARK, {"mark": "resumed"})
+    assert s == 4  # continues after the last GOOD record
+    w2.close()
+    r = JournalReader(str(tmp_path / "re"))
+    recs = list(r.records())
+    assert [h["seq"] for h, _ in recs] == [1, 2, 3, 4]
+    assert not r.losses  # recovery truncated the tear on reopen
+
+
+# -- rotation, index, range reads, retention GC -----------------------------
+
+def test_rotation_seals_segments_with_index_ranges(tmp_path):
+    w = JournalWriter(str(tmp_path / "rot"), max_segment_bytes=1 << 12,
+                      max_segment_age=0)
+    for i in range(200):
+        w.append(wire.EV_BATCH_NPZ, {"i": i}, os.urandom(100))
+    w.close()
+    idx = read_jsonl(str(tmp_path / "rot" / INDEX)).records
+    assert len(idx) >= 2
+    # index rows carry contiguous seq ranges
+    assert idx[0]["first_seq"] == 1
+    for a, b in zip(idx, idx[1:]):
+        assert b["first_seq"] == a["last_seq"] + 1
+    r = JournalReader(str(tmp_path / "rot"))
+    assert sum(1 for _ in r.records()) == 200
+
+
+def test_range_reads_use_seq_and_ts(tmp_path):
+    t = [100.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    w = JournalWriter(str(tmp_path / "rng"), max_segment_bytes=1 << 12,
+                      max_segment_age=0, clock=clock)
+    for i in range(120):
+        w.append(wire.EV_BATCH_NPZ, {"i": i}, b"x" * 64)
+    w.close()
+    r = JournalReader(str(tmp_path / "rng"))
+    seqs = [h["seq"] for h, _ in r.records(start_seq=50, end_seq=60)]
+    assert seqs == list(range(50, 61))
+    ts_recs = [h for h, _ in r.records(start_ts=150.0, end_ts=160.0)]
+    assert ts_recs and all(150.0 <= h["ts"] <= 160.0 for h in ts_recs)
+
+
+def test_retention_gc_under_concurrent_writes(tmp_path):
+    w = JournalWriter(str(tmp_path / "gc"), max_segment_bytes=1 << 12,
+                      max_segment_age=0, retention_bytes=3 << 12)
+    errors: list[BaseException] = []
+
+    def pump(tid: int):
+        try:
+            for _ in range(150):
+                w.append(wire.EV_BATCH_NPZ, {"tid": tid}, os.urandom(120))
+        except BaseException as e:  # noqa: BLE001 — surfaced via the list
+            errors.append(e)
+
+    threads = [threading.Thread(target=pump, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    w.close()
+    assert not errors, errors
+    r = JournalReader(str(tmp_path / "gc"))
+    seqs = [h["seq"] for h, _ in r.records()]
+    # GC dropped oldest sealed segments; what survives is a strictly
+    # increasing contiguous SUFFIX of the stream ending at the last seq
+    assert seqs and seqs[-1] == 600
+    assert seqs == list(range(seqs[0], 601))
+    assert r.missing_segments  # the GC'd history is visible, not silent
+    segs = [f for f in os.listdir(tmp_path / "gc") if f.endswith(".igj")]
+    total = sum(os.path.getsize(tmp_path / "gc" / f) for f in segs)
+    assert total <= (3 << 12) + (2 << 12)  # retention + one active segment
+
+
+# -- manifest provenance + digests ------------------------------------------
+
+def test_manifest_provenance_round_trip(tmp_path):
+    m = build_manifest(journal_id="jid", node="n0", gadget="trace/exec",
+                       run_id="r1", params={"gadget.seed": "7"})
+    w = JournalWriter(str(tmp_path / "prov"), manifest=m)
+    w.append(wire.EV_JOURNAL_MARK, {"mark": "x"})
+    w.close()
+    r = JournalReader(str(tmp_path / "prov"))
+    got = r.manifest
+    assert got["schema"] == "ig-tpu/capture-journal/v1"
+    assert (got["node"], got["gadget"], got["run_id"]) == \
+        ("n0", "trace/exec", "r1")
+    assert got["params"] == {"gadget.seed": "7"}
+    assert "git_sha" in got and "platform" in got and "host" in got
+    assert got["last_seq"] == 1 and got["closed_ts"] >= got["created_ts"]
+
+
+def test_digest_stable_and_append_sensitive(tmp_path):
+    w = _write(tmp_path, name="dig", n=4)
+    w.close()
+    r1 = JournalReader(str(tmp_path / "dig"))
+    d1 = r1.digest()
+    assert d1 == JournalReader(str(tmp_path / "dig")).digest()
+    w2 = JournalWriter(str(tmp_path / "dig"))
+    w2.append(wire.EV_JOURNAL_MARK, {"mark": "more"})
+    w2.close()
+    assert JournalReader(str(tmp_path / "dig")).digest() != d1
+
+
+def test_summary_digest_ignores_names_only(tmp_path):
+    base = {"events": 10, "drops": 0, "distinct": 3.5, "entropy": 1.25,
+            "epoch": 2, "heavy_hitters": [(1, 5), (2, 3)]}
+    a = summary_digest({**base, "names": {"1": "x"}})
+    b = summary_digest({**base, "names": {"1": "y"}})
+    assert a == b
+    assert summary_digest({**base, "events": 11}) != a
+
+
+def test_scan_segment_reports_unreadable(tmp_path):
+    recs, loss = scan_segment(str(tmp_path / "nope.igj"))
+    assert recs == [] and loss is not None and "unreadable" in loss.reason
+
+
+# -- recording manager ------------------------------------------------------
+
+def test_recording_manager_lifecycle(tmp_path):
+    base = str(tmp_path / "area")
+    rec = RECORDINGS.start("rec-1", base_dir=base)
+    try:
+        w = rec.writer_for(node="n0", gadget="trace/exec", run_id="runA",
+                           params={"k": "v"})
+        w.append(wire.EV_BATCH_NPZ, {"count": 1}, b"z")
+        listed = [r for r in RECORDINGS.list(base) if r["id"] == "rec-1"]
+        assert listed and listed[0]["state"] == "recording"
+    finally:
+        meta = RECORDINGS.stop("rec-1")
+    assert meta["journals"] == ["n0--runA"]
+    assert is_journal(os.path.join(base, "rec-1", "n0--runA"))
+    insp = RECORDINGS.inspect("rec-1", base)
+    assert insp["state"] == "stopped"
+    j = insp["journals"]["n0--runA"]
+    # recording-start mark + batch + recording-stop mark
+    assert j["records"] == 3 and not j["losses"]
+    stopped = [r for r in RECORDINGS.list(base) if r["id"] == "rec-1"]
+    assert stopped and stopped[0]["state"] == "stopped"
+    with pytest.raises(KeyError):
+        RECORDINGS.stop("rec-1")
+
+
+def test_reopen_after_clean_close_starts_next_segment(tmp_path):
+    """Appending into a SEALED segment would silently invalidate its
+    index row (stale last_seq/bytes, duplicate rows on the next seal) —
+    a reopen after close() must start the next segment instead."""
+    w = _write(tmp_path, name="sealed", n=3)
+    w.close()  # seals seg-00000001 into the index
+    w2 = JournalWriter(str(tmp_path / "sealed"))
+    w2.append(wire.EV_JOURNAL_MARK, {"mark": "after-close"})
+    w2.close()
+    idx = read_jsonl(str(tmp_path / "sealed" / INDEX)).records
+    files = [row["file"] for row in idx]
+    assert files == ["seg-00000001.igj", "seg-00000002.igj"]
+    assert idx[0]["last_seq"] == 3 and idx[1]["first_seq"] == 4
+    r = JournalReader(str(tmp_path / "sealed"))
+    assert [h["seq"] for h, _ in r.records()] == [1, 2, 3, 4]
+    assert [h["seq"] for h, _ in r.records(start_seq=4)] == [4]
+
+
+def test_recovered_tail_keeps_its_timestamps(tmp_path):
+    """A crash-recovered tail segment must seal with the REAL last_ts —
+    a zeroed one makes time-range reads skip the whole segment."""
+    t = [1000.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    w = JournalWriter(str(tmp_path / "ts"), clock=clock)
+    for _ in range(3):
+        w.append(wire.EV_BATCH_NPZ, {}, b"x")
+    # crash: no close(); reopen and seal without any new appends
+    w2 = JournalWriter(str(tmp_path / "ts"), clock=clock)
+    w2.close()
+    idx = read_jsonl(str(tmp_path / "ts" / INDEX)).records
+    assert idx and idx[-1]["last_ts"] >= 1000.0
+    r = JournalReader(str(tmp_path / "ts"))
+    assert sum(1 for _ in r.records(start_ts=1000.0)) == 3
+
+
+def test_torn_index_line_repaired_on_reopen(tmp_path):
+    """A crash mid-seal can tear an index.jsonl line; a reopened writer
+    must repair it (atomic rewrite of the good rows) — otherwise every
+    later seal row lands after the tear and stays invisible to the
+    on_bad='stop' readers forever."""
+    w = JournalWriter(str(tmp_path / "ix"), max_segment_bytes=1 << 12,
+                      max_segment_age=0)
+    for _ in range(80):
+        w.append(wire.EV_BATCH_NPZ, {}, os.urandom(100))
+    # crash mid-seal: a torn line at the index tail, no close()
+    ipath = str(tmp_path / "ix" / INDEX)
+    good_rows = read_jsonl(ipath).records
+    assert good_rows
+    with open(ipath, "a") as f:
+        f.write('{"file": "seg-')
+    w2 = JournalWriter(str(tmp_path / "ix"))
+    for _ in range(80):
+        w2.append(wire.EV_BATCH_NPZ, {}, os.urandom(100))
+    w2.close()
+    idx = read_jsonl(ipath, on_bad="stop")
+    assert not idx.skipped  # repaired: nothing hides behind a torn line
+    assert len(idx.records) > len(good_rows)
+    r = JournalReader(str(tmp_path / "ix"))
+    seqs = [h["seq"] for h, _ in r.records()]
+    assert seqs == list(range(1, 161))
+
+
+def test_recording_id_validation_guards_path_resolution(tmp_path):
+    """The agent's recording RPCs resolve <base>/<id> for ids a client
+    sent: separators, '..', and absolute ids must be refused, not
+    joined (os.path.join discards the base on an absolute component)."""
+    from inspektor_gadget_tpu.capture.manager import validate_recording_id
+    for bad in ("/etc", "a/b", "..", ".", "", "../x"):
+        with pytest.raises(ValueError):
+            validate_recording_id(bad)
+        with pytest.raises(ValueError):
+            RECORDINGS.recording_dir(bad, str(tmp_path))
+    assert validate_recording_id("incident-7.2") == "incident-7.2"
+
+
+def test_fetch_recording_refuses_zip_slip_listing(tmp_path):
+    """A compromised agent's listing must not write outside dest_dir."""
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    client = AgentClient.__new__(AgentClient)
+    client.node_name = "evil"
+    client.list_recordings = lambda rid: {
+        "files": [{"path": "../../escape.txt", "bytes": 1}]}
+    client.fetch_file = lambda *a, **k: pytest.fail(
+        "must refuse before fetching")
+    with pytest.raises(RuntimeError, match="escaping the bundle"):
+        client.fetch_recording("r", str(tmp_path / "dest"))
+
+
+def test_recording_manager_rejects_bad_ids_and_duplicates(tmp_path):
+    base = str(tmp_path / "area2")
+    with pytest.raises(ValueError):
+        RECORDINGS.start("../escape", base_dir=base)
+    RECORDINGS.start("dup", base_dir=base)
+    try:
+        with pytest.raises(ValueError):
+            RECORDINGS.start("dup", base_dir=base)
+    finally:
+        RECORDINGS.stop("dup")
+    with pytest.raises(ValueError):  # stopped-on-disk is also a collision
+        RECORDINGS.start("dup", base_dir=base)

@@ -30,7 +30,8 @@ def test_probe_windows_names_and_shape():
     expected = {"native_lib", "fanotify", "perf", "kmsg", "ptrace",
                 "sock_diag", "netlink_proc", "af_packet", "mountinfo",
                 "procfs", "blktrace", "tcpinfo", "audit", "captrace",
-                "fstrace", "sockstate", "sigtrace", "container_runtime"}
+                "fstrace", "sockstate", "sigtrace", "container_runtime",
+                "capture_dir"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail

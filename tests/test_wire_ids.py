@@ -1,0 +1,85 @@
+"""Tier-1 lint: EV_* wire constants must be unique and registered in the
+one WIRE_EVENT_IDS table (tools/check_wire_ids.py) — PR 4 hand-assigned
+EV_ALERT=7 with nothing preventing a future collision; this gate makes a
+collision or an unregistered id a test failure. Plus self-tests that the
+checker catches each drift mode, and a runtime cross-check that the
+imported module agrees with its own table."""
+
+from __future__ import annotations
+
+import textwrap
+
+from tools.check_wire_ids import check_file, check_source
+
+
+def test_repo_wire_ids_are_registered_and_unique():
+    violations = check_file()
+    assert not violations, "\n".join(violations)
+
+
+def test_runtime_table_matches_module_constants():
+    from inspektor_gadget_tpu.agent import wire
+    for name, value in wire.WIRE_EVENT_IDS.items():
+        assert getattr(wire, name) == value
+    consts = {n: v for n, v in vars(wire).items()
+              if n.startswith("EV_") and n != "EV_LOG_SHIFT"}
+    assert consts == wire.WIRE_EVENT_IDS
+    values = list(wire.WIRE_EVENT_IDS.values())
+    assert len(values) == len(set(values))
+    assert all(0 < v < (1 << wire.EV_LOG_SHIFT) for v in values)
+
+
+def _src(body: str) -> str:
+    return textwrap.dedent(body)
+
+
+def test_checker_flags_duplicate_ids():
+    src = _src("""
+        EV_A = 1
+        EV_B = 1
+        WIRE_EVENT_IDS = {"EV_A": EV_A, "EV_B": EV_B}
+    """)
+    assert any("multiple constants" in v for v in check_source(src, "w.py"))
+
+
+def test_checker_flags_unregistered_constant():
+    src = _src("""
+        EV_A = 1
+        EV_B = 2
+        WIRE_EVENT_IDS = {"EV_A": EV_A}
+    """)
+    assert any("not registered" in v for v in check_source(src, "w.py"))
+
+
+def test_checker_flags_stale_table_row_and_value_mismatch():
+    stale = _src("""
+        EV_A = 1
+        WIRE_EVENT_IDS = {"EV_A": EV_A, "EV_GONE": 9}
+    """)
+    assert any("stale" in v for v in check_source(stale, "w.py"))
+    mismatch = _src("""
+        EV_A = 1
+        WIRE_EVENT_IDS = {"EV_A": 2}
+    """)
+    assert any("registers 2" in v for v in check_source(mismatch, "w.py"))
+
+
+def test_checker_flags_severity_bit_collision_and_missing_table():
+    collide = _src("""
+        EV_LOG_SHIFT = 16
+        EV_HUGE = 65536
+        WIRE_EVENT_IDS = {"EV_HUGE": EV_HUGE}
+    """)
+    assert any("severity bits" in v for v in check_source(collide, "w.py"))
+    assert any("no WIRE_EVENT_IDS" in v
+               for v in check_source("EV_A = 1\n", "w.py"))
+
+
+def test_checker_allows_the_clean_shape():
+    src = _src("""
+        EV_A = 1
+        EV_B = 2
+        EV_LOG_SHIFT = 16
+        WIRE_EVENT_IDS = {"EV_A": EV_A, "EV_B": EV_B}
+    """)
+    assert check_source(src, "w.py") == []

@@ -1,0 +1,161 @@
+#!/usr/bin/env python3
+"""Lint: EV_* wire constants must be unique and registered in ONE table.
+
+PR 4 hand-assigned `EV_ALERT = 7` with nothing preventing a later plane
+from hand-assigning 7 again — a collision that corrupts stream decode
+far from the assignment site. This check makes the WIRE_EVENT_IDS table
+in agent/wire.py authoritative, the same way the bare-except and
+gadget-docs checks gate their drift modes:
+
+  * every module-level ``EV_<NAME> = <int>`` constant (except the
+    declared non-event bit constants, e.g. EV_LOG_SHIFT) must appear in
+    the table with the same value;
+  * every table entry must correspond to a constant (no stale rows);
+  * ids must be unique, positive, and below 1 << EV_LOG_SHIFT (values at
+    or above it would read as log-severity bits on the stream).
+
+Pure AST — the check runs on source text, so it works in environments
+where importing the package (grpc, numpy) is undesirable. Run standalone
+(``python tools/check_wire_ids.py [wire.py]``, exit 1 on violations) or
+through the tier-1 suite (tests/test_wire_ids.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+DEFAULT_WIRE = (pathlib.Path(__file__).resolve().parent.parent
+                / "inspektor_gadget_tpu" / "agent" / "wire.py")
+TABLE = "WIRE_EVENT_IDS"
+# bit-layout constants that are not event ids (shift amounts, masks)
+NON_EVENT = {"EV_LOG_SHIFT"}
+
+
+def _int_const(node: ast.expr) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def check_source(src: str, path: str = "<string>") -> list[str]:
+    """Return 'path:line: message' violation strings for one wire module."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno or 0}: unparseable: {e.msg}"]
+
+    consts: dict[str, tuple[int, int]] = {}   # name -> (value, line)
+    table: dict[str, tuple[int, int]] | None = None
+    table_line = 0
+    out: list[str] = []
+
+    for node in tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id.startswith("EV_") and t.id not in NON_EVENT:
+                v = _int_const(value)
+                if v is None:
+                    out.append(f"{path}:{node.lineno}: {t.id} must be a "
+                               "plain int literal (computed wire ids hide "
+                               "collisions from this check)")
+                else:
+                    consts[t.id] = (v, node.lineno)
+            elif t.id == TABLE and isinstance(value, ast.Dict):
+                table = {}
+                table_line = node.lineno
+                for k, v in zip(value.keys, value.values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        out.append(f"{path}:{node.lineno}: {TABLE} keys "
+                                   "must be string literals")
+                        continue
+                    # values may be the constant Name (preferred) or a
+                    # literal; resolve Names through the constants seen
+                    if isinstance(v, ast.Name):
+                        if v.id in consts:
+                            table[k.value] = (consts[v.id][0], v.lineno)
+                        else:
+                            out.append(
+                                f"{path}:{v.lineno}: {TABLE}[{k.value!r}] "
+                                f"references unknown constant {v.id}")
+                    else:
+                        iv = _int_const(v)
+                        if iv is None:
+                            out.append(
+                                f"{path}:{v.lineno}: {TABLE}[{k.value!r}] "
+                                "must be an int or an EV_* name")
+                        else:
+                            table[k.value] = (iv, v.lineno)
+
+    if table is None:
+        out.append(f"{path}:1: no {TABLE} table found — every EV_* wire id "
+                   "must be registered in one authoritative table")
+        return out
+
+    shift = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "EV_LOG_SHIFT":
+                    shift = _int_const(node.value)
+    limit = (1 << shift) if shift else None
+
+    for name, (value, line) in sorted(consts.items()):
+        if name not in table:
+            out.append(f"{path}:{line}: {name} = {value} is not registered "
+                       f"in {TABLE} — add it (collisions must be visible "
+                       "in one place)")
+        elif table[name][0] != value:
+            out.append(f"{path}:{line}: {name} = {value} but {TABLE} "
+                       f"registers {table[name][0]}")
+        if value <= 0:
+            out.append(f"{path}:{line}: {name} = {value} must be positive")
+        elif limit is not None and value >= limit:
+            out.append(f"{path}:{line}: {name} = {value} collides with the "
+                       f"log-severity bits (ids must stay below "
+                       f"1 << EV_LOG_SHIFT = {limit})")
+
+    for name, (value, line) in sorted(table.items()):
+        if name not in consts:
+            out.append(f"{path}:{line}: {TABLE} row {name!r} has no "
+                       "matching EV_* constant — stale entry")
+
+    by_value: dict[int, list[str]] = {}
+    for name, (value, _line) in consts.items():
+        by_value.setdefault(value, []).append(name)
+    for value, names in sorted(by_value.items()):
+        if len(names) > 1:
+            out.append(f"{path}:{table_line}: wire id {value} assigned to "
+                       f"multiple constants: {', '.join(sorted(names))}")
+    return out
+
+
+def check_file(path: str | pathlib.Path = DEFAULT_WIRE) -> list[str]:
+    p = pathlib.Path(path)
+    return check_source(p.read_text(encoding="utf-8"), str(p))
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    path = args[0] if args else str(DEFAULT_WIRE)
+    violations = check_file(path)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
