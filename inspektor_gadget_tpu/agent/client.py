@@ -265,6 +265,61 @@ class AgentClient:
                                      os.path.join(dest_dir, rel))
         return {"files": len(files), "bytes": total}
 
+    # -- sketch-history plane (history/) ------------------------------------
+
+    def list_windows(self, *, gadget: str = "",
+                     start_ts: float | None = None,
+                     end_ts: float | None = None,
+                     start_seq: int | None = None,
+                     end_seq: int | None = None,
+                     key: str | None = None) -> dict:
+        """Header rows of this node's sealed windows overlapping the
+        range/slice — the cheap pruning pass before fetch_windows."""
+        return self._unary("ListWindows", {
+            "gadget": gadget, "start_ts": start_ts, "end_ts": end_ts,
+            "start_seq": start_seq, "end_seq": end_seq, "key": key})
+
+    def fetch_windows(self, *, gadget: str = "",
+                      start_ts: float | None = None,
+                      end_ts: float | None = None,
+                      start_seq: int | None = None,
+                      end_seq: int | None = None,
+                      key: str | None = None,
+                      chunk_bytes: int = 1 << 20
+                      ) -> tuple[list[tuple[dict, bytes]], list[dict]]:
+        """Pull every matching window's (header, payload) frame in
+        chunks under the gRPC cap; returns (frames, torn-tail losses).
+        A truncated reply tail is dropped-and-accounted client-side with
+        the same rule a torn segment gets."""
+        from ..history import unpack_frames
+        method = self.channel.unary_unary(
+            "/igtpu.GadgetManager/FetchWindows",
+            request_serializer=wire.identity_serializer,
+            response_deserializer=wire.identity_deserializer,
+        )
+        frames: list[tuple[dict, bytes]] = []
+        losses: list[dict] = []
+        offset = 0
+        while True:
+            reply = method(wire.encode_msg({
+                "gadget": gadget, "start_ts": start_ts, "end_ts": end_ts,
+                "start_seq": start_seq, "end_seq": end_seq, "key": key,
+                "offset": offset, "max_bytes": chunk_bytes}),
+                timeout=CONNECT_TIMEOUT)
+            h, payload = wire.decode_msg(reply)
+            if h.get("error"):
+                raise RuntimeError(h["error"])
+            got, dropped = unpack_frames(payload)
+            frames.extend(got)
+            losses.extend(h.get("losses") or [])
+            if dropped:
+                losses.append({"store": "<fetch>", "segment": "<reply>",
+                               "offset": offset, "dropped_bytes": dropped,
+                               "reason": "truncated fetch reply"})
+            if h.get("eof") or not h.get("count"):
+                return frames, losses
+            offset = int(h.get("next_offset", offset + len(got)))
+
     # -- Trace resources (ref: utils/trace.go:340-848 CreateTrace/
     #    SetTraceOperation/getTraceListFromOptions, over agent RPCs) --------
 

@@ -40,6 +40,11 @@ def main(argv=None) -> int:
                     help="base directory for capture recordings "
                          "(StartRecording RPC / ig-tpu record start); "
                          "default $IG_CAPTURE_DIR or ~/.ig-tpu/capture")
+    sp.add_argument("--history-dir", default="",
+                    help="base directory for the sealed-window sketch "
+                         "history (tpusketch --history true; served via "
+                         "ListWindows/FetchWindows); default "
+                         "$IG_HISTORY_DIR or ~/.ig-tpu/history")
     sp.add_argument("--metrics-addr", default="",
                     help="serve Prometheus text metrics on host:port "
                          "(e.g. :9100); off by default")
@@ -194,6 +199,9 @@ def _serve_loop(args) -> int:
     if args.capture_dir:
         from ..capture import RECORDINGS
         RECORDINGS.set_base_dir(args.capture_dir)
+    if args.history_dir:
+        from ..history import HISTORY
+        HISTORY.set_base_dir(args.history_dir)
     # bind BEFORE installing hooks: a prestart config pointing at a socket
     # nobody serves stalls every container creation on the host
     server, _agent = serve(args.listen, node_name=args.node_name,
@@ -276,6 +284,9 @@ def _serve_loop(args) -> int:
         # unsealed journals for the torn-tail reader to account
         from ..capture import RECORDINGS
         RECORDINGS.stop_all()
+        # same for history stores: close seals active window segments
+        from ..history import HISTORY
+        HISTORY.close_all()
         if installer is not None:
             installer.uninstall()
         server.stop(grace=2.0)

@@ -524,6 +524,94 @@ class AgentServer:
             {"ok": True, "file": rel, "offset": offset, "size": size,
              "eof": offset + len(chunk) >= size}, chunk)
 
+    # -- sketch-history RPCs (history/): range-listing + chunked pulls ------
+
+    @staticmethod
+    def _window_range(h: dict) -> dict:
+        """The (optional) range/slice filter every history RPC accepts —
+        one parse, shared by ListWindows and FetchWindows."""
+        return {
+            "start_ts": float(h["start_ts"]) if h.get("start_ts") is not None else None,
+            "end_ts": float(h["end_ts"]) if h.get("end_ts") is not None else None,
+            "start_seq": int(h["start_seq"]) if h.get("start_seq") is not None else None,
+            "end_seq": int(h["end_seq"]) if h.get("end_seq") is not None else None,
+            "key": h.get("key") or None,
+        }
+
+    def list_windows(self, request: bytes, context) -> bytes:
+        """Header rows of every sealed window overlapping the requested
+        seq/ts range (and slice key) — the pruning half of a fleet-wide
+        range query: the client decides which windows are worth pulling
+        before any payload bytes move."""
+        _tm_rpc.labels(method="ListWindows").inc()
+        h, _ = wire.decode_msg(request)
+        from ..history import HISTORY, validate_store_name
+        gadget = h.get("gadget", "") or ""
+        if gadget:
+            try:
+                validate_store_name(gadget.replace("/", "-"))
+            except ValueError as e:
+                return wire.encode_msg({"error": str(e)})
+        losses: list = []
+        try:
+            # node=self.node_name: an agent serves only windows ITS runs
+            # sealed — in-process fleets share one base area, and a
+            # fan-out merging every node's windows from every node would
+            # double-count
+            rows = HISTORY.list_windows(gadget=gadget, losses=losses,
+                                        node=self.node_name,
+                                        **self._window_range(h))
+        except (OSError, ValueError) as e:
+            return wire.encode_msg({"error": str(e)})
+        return wire.encode_msg({"ok": True, "node": self.node_name,
+                                "windows": rows, "losses": losses})
+
+    def fetch_windows(self, request: bytes, context) -> bytes:
+        """Chunked download of matching windows' frames; every reply
+        stays under the gRPC message cap via offset + max_bytes (the
+        FetchSegment discipline applied to typed windows instead of raw
+        files). Store names resolve server-side only — the one
+        client-supplied path component (gadget) is traversal-guarded."""
+        _tm_rpc.labels(method="FetchWindows").inc()
+        h, _ = wire.decode_msg(request)
+        from ..history import HISTORY, pack_frames, validate_store_name
+        gadget = h.get("gadget", "") or ""
+        if gadget:
+            try:
+                validate_store_name(gadget.replace("/", "-"))
+            except ValueError as e:
+                return wire.encode_msg({"error": str(e)})
+        offset = max(int(h.get("offset", 0)), 0)
+        max_bytes = min(max(int(h.get("max_bytes", 1 << 20)), 1), 2 << 20)
+        losses: list = []
+        picked: list[tuple[dict, bytes]] = []
+        size = 0
+        eof = True
+        try:
+            it = HISTORY.fetch_windows(gadget=gadget, losses=losses,
+                                       node=self.node_name,
+                                       **self._window_range(h))
+            for i, (header, payload) in enumerate(it):
+                if i < offset:
+                    continue
+                frame_size = len(payload) + 512  # header slack
+                if picked and size + frame_size > max_bytes:
+                    eof = False
+                    break
+                picked.append((header, payload))
+                size += frame_size
+        except (OSError, ValueError) as e:
+            return wire.encode_msg({"error": str(e)})
+        return wire.encode_msg(
+            {"ok": True, "node": self.node_name, "count": len(picked),
+             "offset": offset, "next_offset": offset + len(picked),
+             "eof": eof,
+             # every chunk rescans from frame 0, so only the FIRST chunk
+             # reports torn-tail losses — the client concatenates reply
+             # losses, and repeating them would multiply the accounting
+             "losses": losses if offset == 0 else []},
+            pack_frames(picked))
+
     # -- dump-state debug RPC (ref: gadgettracermanager.go DumpState :204) --
 
     def dump_state(self, request: bytes, context) -> bytes:
@@ -645,6 +733,9 @@ def serve(address: str = "unix:///tmp/igtpu-agent.sock",
         "ListRecordings": _method(agent.list_recordings, "unary",
                                   "ListRecordings"),
         "FetchSegment": _method(agent.fetch_segment, "unary", "FetchSegment"),
+        "ListWindows": _method(agent.list_windows, "unary", "ListWindows"),
+        "FetchWindows": _method(agent.fetch_windows, "unary",
+                                "FetchWindows"),
         "ApplyTrace": _method(agent.apply_trace, "unary", "ApplyTrace"),
         "GetTrace": _method(agent.get_trace, "unary", "GetTrace"),
         "ListTraces": _method(agent.list_traces, "unary", "ListTraces"),

@@ -27,3 +27,4 @@ from .operators import kubeipresolver as _kubeipresolver  # noqa: F401
 from .operators import alertsop as _alertsop  # noqa: F401
 from .capture import operator as _captureop  # noqa: F401
 from .gadgets.top import recordings as _top_recordings  # noqa: F401
+from .gadgets.top import windows as _top_windows  # noqa: F401

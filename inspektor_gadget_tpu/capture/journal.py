@@ -64,17 +64,31 @@ DEFAULT_SEGMENT_AGE = 60.0
 DEFAULT_RETENTION_BYTES = 256 << 20
 DEFAULT_RETENTION_SEGMENTS = 0  # 0 = unlimited count (bytes still bound)
 
-_tm_records = counter("ig_capture_records_total",
-                      "records appended to capture journals", ("type",))
-_tm_bytes = counter("ig_capture_bytes_total",
-                    "bytes appended to capture journals")
-_tm_drops = counter("ig_capture_drops_total",
-                    "capture records lost (torn tails on reopen, failed "
-                    "appends)", ("reason",))
-_tm_gc = counter("ig_capture_gc_total",
-                 "sealed segments deleted by retention GC")
-_tm_active = gauge("ig_capture_active_journals", "open journal writers")
+@dataclasses.dataclass(frozen=True)
+class JournalMetrics:
+    """The counter family one journal plane accounts into. The capture
+    plane owns ig_capture_*; the sketch-history store (history/store.py)
+    reuses the whole writer/reader machinery but must not launder its
+    window traffic through capture's counters, so it passes its own."""
+    records: Any    # counter("...", labels=("type",))
+    bytes: Any      # counter
+    drops: Any      # counter("...", labels=("reason",))
+    gc: Any         # counter
+    active: Any     # gauge
 
+
+CAPTURE_METRICS = JournalMetrics(
+    records=counter("ig_capture_records_total",
+                    "records appended to capture journals", ("type",)),
+    bytes=counter("ig_capture_bytes_total",
+                  "bytes appended to capture journals"),
+    drops=counter("ig_capture_drops_total",
+                  "capture records lost (torn tails on reopen, failed "
+                  "appends)", ("reason",)),
+    gc=counter("ig_capture_gc_total",
+               "sealed segments deleted by retention GC"),
+    active=gauge("ig_capture_active_journals", "open journal writers"),
+)
 
 def capture_base_dir(path: str | None = None) -> str:
     """The node-wide default recording area: $IG_CAPTURE_DIR, else
@@ -148,8 +162,10 @@ class JournalWriter:
                  max_segment_age: float = DEFAULT_SEGMENT_AGE,
                  retention_bytes: int = DEFAULT_RETENTION_BYTES,
                  retention_segments: int = DEFAULT_RETENTION_SEGMENTS,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 metrics: JournalMetrics = CAPTURE_METRICS):
         self.path = path
+        self._m = metrics
         self.max_segment_bytes = max(int(max_segment_bytes), 1 << 12)
         self.max_segment_age = float(max_segment_age)
         self.retention_bytes = int(retention_bytes)
@@ -166,7 +182,7 @@ class JournalWriter:
             doc, err = read_json_file(mpath)
             self.manifest = doc or build_manifest()
             if err:
-                _tm_drops.labels(reason="manifest").inc()
+                self._m.drops.labels(reason="manifest").inc()
             self._recover()
         else:
             self.manifest = manifest or build_manifest()
@@ -182,7 +198,7 @@ class JournalWriter:
             self._seg_first_ts = None
             self._seq = 0
             self._last_ts = 0.0
-        _tm_active.inc()
+        self._m.active.inc()
 
     # -- recovery -----------------------------------------------------------
 
@@ -204,7 +220,7 @@ class JournalWriter:
                     f.write(json.dumps(row, sort_keys=True,
                                        separators=(",", ":")) + "\n")
             os.replace(tmp, ipath)
-            _tm_drops.labels(reason="index").inc()
+            self._m.drops.labels(reason="index").inc()
         for line in idx.records:
             self._seq = max(self._seq, int(line.get("last_seq", 0)))
             self._last_ts = max(self._last_ts,
@@ -219,7 +235,7 @@ class JournalWriter:
             if loss is not None:
                 with open(tail, "r+b") as f:
                     f.truncate(loss.offset)
-                _tm_drops.labels(reason="torn_tail").inc()
+                self._m.drops.labels(reason="torn_tail").inc()
             self._seg_n = _seg_number(tail)
             self._seg_bytes = os.path.getsize(tail)
             self._seg_records = len(records)
@@ -267,7 +283,7 @@ class JournalWriter:
                 append_bytes(self._active_path(), frame)
             except OSError:
                 self._seq -= 1
-                _tm_drops.labels(reason="append").inc()
+                self._m.drops.labels(reason="append").inc()
                 raise
             if self._seg_first_seq is None:
                 self._seg_first_seq = seq
@@ -275,8 +291,8 @@ class JournalWriter:
             self._seg_bytes += len(frame)
             self._seg_records += 1
             self._last_ts = now
-            _tm_records.labels(type=str(ev_type)).inc()
-            _tm_bytes.inc(len(frame))
+            self._m.records.labels(type=str(ev_type)).inc()
+            self._m.bytes.inc(len(frame))
             return seq
 
     def mark(self, mark: str, **fields) -> int:
@@ -300,6 +316,14 @@ class JournalWriter:
         self._seal_locked()
         self._gc_locked()
 
+    def _index_extra_locked(self) -> dict:
+        """Subclass hook: extra fields merged into the seal row of the
+        segment being sealed (the history store adds the subpopulation
+        keys its windows carry, so range queries can skip whole segments
+        by slice key). Called under _mu; must also reset any per-segment
+        accumulation it maintains."""
+        return {}
+
     def _seal_locked(self) -> None:
         append_line(os.path.join(self.path, INDEX), {
             "file": _seg_name(self._seg_n),
@@ -310,6 +334,7 @@ class JournalWriter:
             "first_ts": self._seg_first_ts,
             "last_ts": self._last_ts,
             "sealed_ts": self._clock(),
+            **self._index_extra_locked(),
         })
         self._seg_n += 1
         self._seg_bytes = 0
@@ -341,7 +366,7 @@ class JournalWriter:
                 break  # a racing reader on a shared FS: stop, retry next GC
             total -= size
             removed += 1
-            _tm_gc.inc()
+            self._m.gc.inc()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -372,7 +397,7 @@ class JournalWriter:
             if self._seg_records:
                 self._seal_locked()
             self._closed = True
-        _tm_active.dec()
+        self._m.active.dec()
         mpath = os.path.join(self.path, MANIFEST)
         doc, _err = read_json_file(mpath)
         doc = doc or dict(self.manifest)
@@ -384,7 +409,7 @@ class JournalWriter:
                 json.dump(doc, f, sort_keys=True)
             os.replace(tmp, mpath)
         except OSError:
-            _tm_drops.labels(reason="manifest").inc()
+            self._m.drops.labels(reason="manifest").inc()
         return {"path": self.path, "records": self._seq,
                 "segments": len(_list_segments(self.path))}
 
@@ -451,11 +476,13 @@ class JournalReader:
     seq/time range reads skip whole sealed segments; the (possibly torn)
     active segment is always scanned directly."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, *,
+                 metrics: JournalMetrics = CAPTURE_METRICS):
         if not is_journal(path):
             raise FileNotFoundError(f"{path}: not a capture journal "
                                     f"(no {MANIFEST})")
         self.path = path
+        self._m = metrics
         doc, err = read_json_file(os.path.join(path, MANIFEST))
         self.manifest: dict = doc or {}
         self.manifest_error = err
@@ -508,7 +535,7 @@ class JournalReader:
             records, loss = scan_segment(seg)
             if loss is not None:
                 self.losses.append(loss)
-                _tm_drops.labels(reason="torn_tail").inc()
+                self._m.drops.labels(reason="torn_tail").inc()
             for header, payload in records:
                 seq = header.get("seq", 0)
                 ts = header.get("ts", 0.0)
@@ -660,8 +687,9 @@ def summary_to_dict(summary: Any) -> dict:
     }
 
 
-__all__ = ["DEFAULT_RETENTION_BYTES", "DEFAULT_SEGMENT_AGE",
-           "DEFAULT_SEGMENT_BYTES", "INDEX", "JOURNAL_SCHEMA", "JournalReader",
+__all__ = ["CAPTURE_METRICS", "DEFAULT_RETENTION_BYTES",
+           "DEFAULT_SEGMENT_AGE", "DEFAULT_SEGMENT_BYTES", "INDEX",
+           "JOURNAL_SCHEMA", "JournalMetrics", "JournalReader",
            "JournalWriter", "MANIFEST", "SegmentLoss", "build_manifest",
            "capture_base_dir", "dir_stats", "is_journal", "scan_segment",
            "summary_digest", "summary_to_dict"]

@@ -321,6 +321,9 @@ def replay_journal(path: str, *, speed: float = 0.0,
             "on_sketch_summary": collect_summary,
             "on_alert_event": collect_alert,
             "node": reader.manifest.get("node", "") or "replay",
+            # windows resealed during replay keep the RECORDED gadget
+            # identity, so their content digests reproduce the live run's
+            "history_gadget": reader.manifest.get("gadget", "") or None,
         },
     )
     result = LocalRuntime(node_name="replay").run_gadget(ctx)

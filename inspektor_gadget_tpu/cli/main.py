@@ -144,6 +144,10 @@ def build_parser() -> argparse.ArgumentParser:
     add_record_parser(sub)
     add_replay_parser(sub)
 
+    # sketch-history plane: fleet-wide range queries over sealed windows
+    from .query import add_query_parser
+    add_query_parser(sub)
+
     vp = sub.add_parser("version", help="print version")
     vp.set_defaults(func=lambda a: (print(_version()), 0)[1])
 

@@ -1,0 +1,133 @@
+"""`ig-tpu query` — fleet-wide historical range queries over sealed
+sketch windows.
+
+The live dashboard answers "what is happening"; this verb answers "what
+was happening": cardinality, heavy hitters, and entropy for any seq/ts
+range — whole-traffic or one subpopulation slice (`--key mntns:<ns>`,
+`--key kind:<syscall>`, `--key 'mntns:<ns>|kind:<k>'`) — merged
+client-side from whichever nodes' sealed windows overlap the range.
+
+    ig-tpu query --remote n0=...,n1=... --last 1h --key mntns:4026531840
+    ig-tpu query --history ./bundle-history --start-ts 1718000000 \
+        --end-ts 1718003600 --slices
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from ..params.validators import parse_duration
+
+
+def add_query_parser(sub) -> None:
+    qp = sub.add_parser(
+        "query", help="historical range queries over sealed sketch "
+        "windows: cardinality / heavy hitters / entropy for a (key, "
+        "time-range) slice, merged across nodes")
+    qp.add_argument("--remote", default="",
+                    help="fan out to agents: name=target[,...]; default: "
+                         "the local history store")
+    qp.add_argument("--history", default="",
+                    help="local history directory to query (default: the "
+                         "node area, $IG_HISTORY_DIR)")
+    qp.add_argument("--gadget", default="",
+                    help="restrict to one gadget's windows, e.g. trace/exec")
+    qp.add_argument("--start-ts", type=float, default=None,
+                    help="range start (epoch seconds)")
+    qp.add_argument("--end-ts", type=float, default=None,
+                    help="range end (epoch seconds)")
+    qp.add_argument("--last", default="",
+                    help="relative range shorthand: 15m / 2h / 90s "
+                         "(overrides --start-ts)")
+    qp.add_argument("--start-seq", type=int, default=None)
+    qp.add_argument("--end-seq", type=int, default=None)
+    qp.add_argument("--key", default="",
+                    help="subpopulation slice, e.g. mntns:4026531840, "
+                         "kind:59, 'mntns:...|kind:59'")
+    qp.add_argument("--slices", action="store_true",
+                    help="also print every observed slice (default: only "
+                         "--key's)")
+    qp.add_argument("--top", type=int, default=10,
+                    help="heavy hitters to print")
+    qp.add_argument("-o", "--output", default="table",
+                    choices=["table", "json"])
+    qp.set_defaults(func=cmd_query)
+
+
+def cmd_query(args) -> int:
+    from ..params import ParamError
+    start_ts, end_ts = args.start_ts, args.end_ts
+    if args.last:
+        try:
+            start_ts = time.time() - parse_duration(args.last)
+        except ValueError:
+            print(f"error: bad --last {args.last!r}", file=sys.stderr)
+            return 2
+    ranges = dict(gadget=args.gadget, start_ts=start_ts, end_ts=end_ts,
+                  start_seq=args.start_seq, end_seq=args.end_seq)
+    key = args.key or None
+
+    if args.remote:
+        from .main import parse_targets
+        from ..runtime.grpc_runtime import GrpcRuntime
+        try:
+            targets = parse_targets(args.remote)
+        except ParamError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        runtime = GrpcRuntime(targets)
+        try:
+            answer = runtime.query_history(key=key, top=args.top, **ranges)
+        finally:
+            runtime.close()
+    else:
+        from ..history import HISTORY, answer_query, decode_frames
+        losses: list = []
+        frames = list(HISTORY.fetch_windows(
+            base_dir=args.history or None, losses=losses, key=key, **ranges))
+        dropped = [f"local: torn window tail ({loss.get('reason', '?')}, "
+                   f"{loss.get('dropped_bytes', 0)} bytes)"
+                   for loss in losses]
+        answer = answer_query(decode_frames(frames), key=key, top=args.top,
+                              dropped=dropped)
+
+    if args.output == "json":
+        print(json.dumps(answer.to_dict(), indent=2, default=str))
+    else:
+        _print_answer(answer, key=key, show_slices=args.slices,
+                      top=args.top)
+    for node, err in answer.errors.items():
+        print(f"{node}: error: {err}", file=sys.stderr)
+    if answer.windows == 0 and not answer.errors:
+        print("no sealed windows overlap the range", file=sys.stderr)
+    return 1 if answer.errors else 0
+
+
+def _print_answer(answer, *, key: str | None, show_slices: bool,
+                  top: int) -> None:
+    nodes = ",".join(answer.nodes) or "local"
+    print(f"{answer.windows} window(s) [{nodes}] "
+          f"ts {answer.start_ts:.3f} .. {answer.end_ts:.3f}")
+    print(f"events={answer.events:,} drops={answer.drops} "
+          f"distinct≈{answer.distinct:,.0f} "
+          f"entropy={answer.entropy_bits:.2f}b")
+    if answer.heavy_hitters:
+        print("heavy hitters:")
+        for k32, count, label in answer.heavy_hitters[:top]:
+            print(f"  {label:<24s}  {count:>12,}")
+    wanted = ([key] if key else
+              (sorted(answer.slices) if show_slices else []))
+    for skey in wanted:
+        s = answer.slices.get(skey)
+        if s is None:
+            print(f"slice {skey}: not observed in the range")
+            continue
+        print(f"slice {skey}: events={s['events']:,} "
+              f"distinct≈{s['distinct']:,.0f} "
+              f"entropy={s['entropy_bits']:.2f}b")
+        for hh in s["heavy_hitters"][:top]:
+            print(f"  {hh['label']:<24s}  {hh['count']:>12,}")
+    for why in answer.dropped_windows:
+        print(f"dropped: {why}", file=sys.stderr)

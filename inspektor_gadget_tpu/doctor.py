@@ -294,6 +294,37 @@ def _probe_capture_dir() -> Window:
         return Window("capture_dir", False, repr(e))
 
 
+def _probe_history_dir() -> Window:
+    """History-plane row: is the sealed-window store area writable, and
+    how much does it already hold? A node that cannot seal windows
+    answers live queries only — the 2pm incident stays unanswerable at
+    3pm, which is exactly what the history plane exists to fix."""
+    try:
+        import tempfile
+
+        from .capture.journal import dir_stats
+        from .history import history_base_dir
+        base = history_base_dir()
+        os.makedirs(base, exist_ok=True)
+        with tempfile.NamedTemporaryFile(dir=base, prefix=".doctor-"):
+            pass
+        segments, usage = dir_stats(base)
+        try:
+            st = os.statvfs(base)
+            free = st.f_bavail * st.f_frsize
+            free_s = f", {free / (1 << 30):.1f} GiB free"
+        except OSError:
+            free_s = ""
+        return Window("history_dir", True,
+                      f"{base} writable ({usage / (1 << 20):.1f} MiB in "
+                      f"{segments} segment(s){free_s})")
+    except OSError as e:
+        return Window("history_dir", False,
+                      f"history dir unwritable: {e.strerror or e}")
+    except Exception as e:  # noqa: BLE001
+        return Window("history_dir", False, repr(e))
+
+
 def _probe_mountinfo() -> Window:
     try:
         with open("/proc/self/mountinfo") as f:
@@ -319,6 +350,7 @@ _PROBES = (
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
     _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,
     _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
+    _probe_history_dir,
 )
 
 
@@ -383,6 +415,8 @@ _GADGET_WINDOWS: dict[tuple[str, str], tuple[str, str, str]] = {
     ("top", "sketch"): ("native_lib", "", "capture-plane self-observation"),
     ("top", "recordings"): ("capture_dir", "",
                             "recording lifecycle + journal disk usage"),
+    ("top", "windows"): ("history_dir", "",
+                         "sealed-window store contents + freshness"),
     ("top", "self"): ("native_lib", "", "native source self-stats"),
     ("snapshot", "process"): ("procfs", "", "procfs collector"),
     ("snapshot", "socket"): ("procfs", "", "procfs collector"),

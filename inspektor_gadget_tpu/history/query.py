@@ -1,0 +1,139 @@
+"""Historical range queries over sealed windows.
+
+The query side of the disaggregation design: windows were sealed
+per-node with zero coordination; answering "cardinality of tenant X,
+2–3pm, across nodes" is (1) prune — only windows whose [start_ts,
+end_ts] overlap the range and whose key set contains the slice, (2)
+pull — fetch just those windows' frames, (3) fold — the merge algebra
+in history/window.py. This module owns (3) plus the frame packing the
+FetchWindows RPC ships pulled windows in.
+
+Error bounds are the constituent sketches' (documented in
+docs/observability.md): CMS overestimates by ≤ N·e/width per row-min,
+HLL standard error ≈ 1.04/√m, entropy biased down slightly by bucket
+collisions; merging sealed windows adds NO further error (the sketches
+are homomorphic: update-then-merge ≡ merge-then-update).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Iterable
+
+from ..agent import wire
+from .window import SealedWindow, decode_window, merge_windows
+
+# one packed frame = u32 length | u32 crc32(zpayload) | zpayload — the
+# exact journal segment framing, so a fetched byte stream tolerates a
+# truncated tail the same way a segment file does
+_FRAME_HEADER = 8
+
+
+def pack_frames(frames: Iterable[tuple[dict, bytes]]) -> bytes:
+    out = bytearray()
+    for header, payload in frames:
+        zp = zlib.compress(wire.encode_msg(header, payload), 1)
+        out += len(zp).to_bytes(4, "little")
+        out += (zlib.crc32(zp) & 0xFFFFFFFF).to_bytes(4, "little")
+        out += zp
+    return bytes(out)
+
+
+def unpack_frames(data: bytes) -> tuple[list[tuple[dict, bytes]], int]:
+    """(frames, dropped_bytes): a short/undecodable tail is dropped and
+    accounted, never half-decoded — the torn-window read contract."""
+    from ..capture.journal import _decode_frame, _frame_at
+    frames: list[tuple[dict, bytes]] = []
+    off, n = 0, len(data)
+    while off < n:
+        end, zpayload, reason = _frame_at(data, off)
+        decoded = None if reason else _decode_frame(zpayload)
+        if reason or decoded is None:
+            return frames, n - off
+        frames.append(decoded)
+        off = end
+    return frames, 0
+
+
+@dataclasses.dataclass
+class QueryAnswer:
+    """One rendered range-query result (ig-tpu query's output shape)."""
+
+    windows: int
+    nodes: list[str]
+    start_ts: float
+    end_ts: float
+    events: int
+    drops: int
+    distinct: float
+    entropy_bits: float
+    heavy_hitters: list[tuple[int, int, str]]   # (key32, count, label)
+    slices: dict[str, dict]
+    dropped_windows: list[str]      # merges refused (geometry) + torn tails
+    errors: dict[str, str]          # per-node fetch errors (never fatal)
+
+    def to_dict(self) -> dict:
+        return {
+            "windows": self.windows,
+            "nodes": self.nodes,
+            "start_ts": self.start_ts,
+            "end_ts": self.end_ts,
+            "events": self.events,
+            "drops": self.drops,
+            "distinct": self.distinct,
+            "entropy_bits": self.entropy_bits,
+            "heavy_hitters": [
+                {"key": f"0x{k:08x}", "count": c, "label": label}
+                for k, c, label in self.heavy_hitters],
+            "slices": self.slices,
+            "dropped_windows": self.dropped_windows,
+            "errors": self.errors,
+        }
+
+
+def answer_query(windows: Iterable[SealedWindow], *,
+                 key: str | None = None, top: int = 20,
+                 dropped: list[str] | None = None,
+                 errors: dict[str, str] | None = None) -> QueryAnswer:
+    """Fold sealed windows into one QueryAnswer. With `key`, the global
+    numbers still cover the whole merged traffic and `slices` is
+    restricted to that one subpopulation; without it, every observed
+    slice is answered."""
+    merged = merge_windows(windows)
+    labels = merged.names
+    hh = [(k, c, labels.get(k, f"0x{k:08x}"))
+          for k, c in merged.heavy_hitters(top)]
+    slices: dict[str, dict] = {}
+    for skey in ([key] if key else sorted(merged.slices)):
+        ans = merged.slice_answer(skey)
+        if ans is None:
+            continue
+        ans["heavy_hitters"] = [
+            {"key": f"0x{k:08x}", "count": c,
+             "label": labels.get(k, f"0x{k:08x}")}
+            for k, c in ans["heavy_hitters"][:top]]
+        slices[skey] = ans
+    return QueryAnswer(
+        windows=merged.windows,
+        nodes=merged.nodes,
+        start_ts=merged.start_ts,
+        end_ts=merged.end_ts,
+        events=merged.events,
+        drops=merged.drops,
+        distinct=merged.distinct(),
+        entropy_bits=merged.entropy_bits(),
+        heavy_hitters=hh,
+        slices=slices,
+        dropped_windows=list(merged.skipped) + list(dropped or []),
+        errors=dict(errors or {}),
+    )
+
+
+def decode_frames(frames: Iterable[tuple[dict, bytes]]
+                  ) -> list[SealedWindow]:
+    return [decode_window(h, p) for h, p in frames]
+
+
+__all__ = ["QueryAnswer", "answer_query", "decode_frames", "pack_frames",
+           "unpack_frames"]

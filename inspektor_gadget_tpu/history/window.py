@@ -1,0 +1,418 @@
+"""SealedWindow: the unit of the sketch-history plane.
+
+"Sketch Disaggregation Across Time and Space" (arxiv 2503.13515) rests
+on one property this module makes concrete: mergeable sketches sealed
+per time window can be stored cheaply per node and merged lazily at
+query time — count-min tables and entropy buckets add, HLL registers
+max, top-k candidate lists union-and-requery — so "cardinality of
+tenant X, 2–3pm, across nodes" is a client-side fold over whichever
+sealed windows overlap the range, with zero coordination at ingest.
+
+One sealed window carries:
+
+- the window's GLOBAL sketch state (count-min table, HLL registers,
+  entropy buckets, top-k candidates) for whole-traffic range queries;
+- Hydra-style subpopulation slices (arxiv 2208.04927): for each
+  bounded-cardinality slice key observed in the window (``mntns:<ns>``,
+  ``kind:<syscall>``, and the ``mntns:<ns>|kind:<k>`` cross product), a
+  small host-side HLL + entropy-bucket vector + exact truncated
+  heavy-hitter table, so per-pod × per-syscall × time questions answer
+  from sealed state without replaying raw events;
+- a content digest over the decoded state (arrays hashed by value, wall
+  timestamps excluded) — the determinism anchor: replaying the same
+  PR-5 capture journal reseals byte-identical digests.
+
+Encoding is the agent wire idiom: JSON header + one npz payload, framed
+into history segments by history/store.py with the PR-5 journal
+disciplines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+from typing import Iterable
+
+import numpy as np
+
+WINDOW_SCHEMA = "ig-tpu/sketch-window/v1"
+
+# slice-plane geometry: small on purpose — a window carries up to
+# max-slices of these, and the store holds hours of windows
+SLICE_HLL_P = 8            # 256 one-byte registers per slice
+SLICE_ENT_LOG2_WIDTH = 6   # 64 buckets per slice
+SLICE_HH_K = 32            # exact truncated heavy-hitter table per slice
+
+
+def _fmix32_np(h: np.ndarray) -> np.ndarray:
+    """murmur3 finalizer on uint32 numpy lanes — the host-side twin of
+    ops.hashing.fmix32, kept bit-identical so slice sketches built on
+    any node merge coherently."""
+    h = h.astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h ^= h >> np.uint32(13)
+    h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    return h
+
+
+@dataclasses.dataclass
+class SliceSketch:
+    """One subpopulation's per-window state (host-side, numpy-only)."""
+
+    events: int = 0
+    hll: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(1 << SLICE_HLL_P, dtype=np.uint8))
+    ent: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(1 << SLICE_ENT_LOG2_WIDTH,
+                                         dtype=np.int64))
+    hh: dict[int, int] = dataclasses.field(default_factory=dict)
+
+    def update(self, hh_keys: np.ndarray, distinct_keys: np.ndarray,
+               dist_keys: np.ndarray) -> None:
+        self.events += len(hh_keys)
+        # HLL scatter-max over leading-zero ranks (numpy twin of ops.hll)
+        h = _fmix32_np(distinct_keys.astype(np.uint32))
+        p = SLICE_HLL_P
+        idx = (h >> np.uint32(32 - p)).astype(np.int64)
+        rest = ((h << np.uint32(p)) | np.uint32((1 << p) - 1)).astype(np.uint32)
+        # rank = leading zeros + 1 = 32 - floor(log2(rest)); rest is never
+        # 0 (low p bits are padded with ones), and float64 is exact below
+        # 2^32, so the vectorized log2 is the exact clz
+        rank = (np.uint32(32) - np.floor(np.log2(
+            rest.astype(np.float64))).astype(np.uint32)).astype(np.uint8)
+        rank = np.minimum(rank, np.uint8(32 - p + 1))
+        np.maximum.at(self.hll, idx, rank)
+        # entropy buckets over the distribution stream
+        eh = _fmix32_np(dist_keys.astype(np.uint32))
+        eidx = (eh >> np.uint32(32 - SLICE_ENT_LOG2_WIDTH)).astype(np.int64)
+        np.add.at(self.ent, eidx, 1)
+        # exact heavy-hitter counts (truncated to SLICE_HH_K at seal)
+        uniq, counts = np.unique(hh_keys.astype(np.uint32),
+                                 return_counts=True)
+        for k, c in zip(uniq.tolist(), counts.tolist()):
+            if k:
+                self.hh[k] = self.hh.get(k, 0) + c
+
+    def sealed_hh(self) -> list[tuple[int, int]]:
+        return sorted(self.hh.items(), key=lambda kv: -kv[1])[:SLICE_HH_K]
+
+
+def slice_hll_estimate(registers: np.ndarray) -> float:
+    """Standard HLL estimate over one (or a max-merged stack of) slice
+    register vector(s) — numpy twin of ops.hll.hll_estimate."""
+    m = registers.shape[-1]
+    regs = registers.astype(np.float64)
+    alpha = 0.7213 / (1 + 1.079 / m) if m > 64 else \
+        {16: 0.673, 32: 0.697, 64: 0.709}.get(m, 0.7213 / (1 + 1.079 / m))
+    raw = alpha * m * m / np.sum(np.exp2(-regs))
+    zeros = float(np.sum(registers == 0))
+    if raw <= 2.5 * m and zeros > 0:
+        return float(m * np.log(m / max(zeros, 1.0)))
+    return float(raw)
+
+
+def entropy_bits(counts: np.ndarray) -> float:
+    """Shannon entropy (bits) of one bucket-count vector."""
+    c = counts.astype(np.float64)
+    n = c.sum()
+    if n <= 0:
+        return 0.0
+    nz = c[c > 0]
+    return float(np.log2(n) - np.sum(nz * np.log2(nz)) / n)
+
+
+@dataclasses.dataclass
+class SealedWindow:
+    """One decoded window. Arrays mirror the device bundle's per-window
+    state; slices carry the Hydra-lite subpopulation sketches."""
+
+    gadget: str
+    node: str
+    run_id: str
+    window: int                    # per-run window ordinal, 1-based
+    start_ts: float
+    end_ts: float
+    events: int
+    drops: int
+    cms: np.ndarray                # (depth, width) int32
+    hll: np.ndarray                # (m,) int32 — device HLL registers
+    ent: np.ndarray                # (w,) float32 — entropy buckets
+    topk_keys: np.ndarray          # (k,) uint32
+    topk_counts: np.ndarray        # (k,) int64
+    slices: dict[str, dict]        # key → {events, hll, ent, hh}
+    names: dict[int, str] = dataclasses.field(default_factory=dict)
+    slices_dropped: int = 0        # subpopulations over the per-window cap
+    seq: int = 0                   # store seq once appended
+    digest: str = ""
+
+    @property
+    def slice_keys(self) -> list[str]:
+        return sorted(self.slices)
+
+
+def window_digest(win: SealedWindow) -> str:
+    """Content digest of one sealed window: sha256 over the canonical
+    JSON of the decoded state with every array hashed by VALUE. Wall
+    timestamps are excluded — a deterministic replay reproduces the
+    same device math at a different wall time, and the contract is
+    byte-identical digests for byte-identical state."""
+    def arr(a: np.ndarray) -> str:
+        return hashlib.sha256(np.ascontiguousarray(a).tobytes()).hexdigest()
+
+    doc = {
+        "schema": WINDOW_SCHEMA,
+        "gadget": win.gadget,
+        "window": int(win.window),
+        "events": int(win.events),
+        "drops": int(win.drops),
+        "slices_dropped": int(win.slices_dropped),
+        "cms": arr(win.cms),
+        "hll": arr(win.hll),
+        "ent": arr(win.ent),
+        "topk_keys": arr(win.topk_keys),
+        "topk_counts": arr(win.topk_counts),
+        "slices": {
+            key: {
+                "events": int(s["events"]),
+                "hll": arr(s["hll"]),
+                "ent": arr(s["ent"]),
+                "hh": [[int(k), int(c)] for k, c in s["hh"]],
+            }
+            for key, s in sorted(win.slices.items())
+        },
+    }
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def encode_window(win: SealedWindow) -> tuple[dict, bytes]:
+    """SealedWindow → (frame header, npz payload). The header carries
+    everything a ListWindows reply needs (range pruning, slice keys,
+    digest) so listing never ships payload bytes."""
+    arrays: dict[str, np.ndarray] = {
+        "cms": win.cms,
+        "hll": win.hll,
+        "ent": win.ent,
+        "topk_keys": win.topk_keys,
+        "topk_counts": win.topk_counts,
+    }
+    skeys = win.slice_keys
+    if skeys:
+        arrays["slice_events"] = np.array(
+            [win.slices[k]["events"] for k in skeys], dtype=np.int64)
+        arrays["slice_hll"] = np.stack(
+            [win.slices[k]["hll"] for k in skeys]).astype(np.uint8)
+        arrays["slice_ent"] = np.stack(
+            [win.slices[k]["ent"] for k in skeys]).astype(np.int64)
+        hh_keys = np.zeros((len(skeys), SLICE_HH_K), dtype=np.uint32)
+        hh_counts = np.zeros((len(skeys), SLICE_HH_K), dtype=np.int64)
+        for i, k in enumerate(skeys):
+            pairs = win.slices[k]["hh"][:SLICE_HH_K]
+            for j, (key32, c) in enumerate(pairs):
+                hh_keys[i, j] = key32
+                hh_counts[i, j] = c
+        arrays["slice_hh_keys"] = hh_keys
+        arrays["slice_hh_counts"] = hh_counts
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    header = {
+        "schema": WINDOW_SCHEMA,
+        "gadget": win.gadget,
+        "node": win.node,
+        "run_id": win.run_id,
+        "window": int(win.window),
+        "start_ts": float(win.start_ts),
+        "end_ts": float(win.end_ts),
+        "events": int(win.events),
+        "drops": int(win.drops),
+        "slices_dropped": int(win.slices_dropped),
+        "keys": skeys,
+        "names": {str(k): v for k, v in (win.names or {}).items()},
+        "digest": win.digest or window_digest(win),
+    }
+    return header, buf.getvalue()
+
+
+def decode_window(header: dict, payload: bytes) -> SealedWindow:
+    with np.load(io.BytesIO(payload)) as z:
+        arrays = {k: z[k] for k in z.files}
+    skeys = list(header.get("keys") or [])
+    slices: dict[str, dict] = {}
+    if skeys and "slice_events" in arrays:
+        for i, key in enumerate(skeys):
+            hh_k = arrays["slice_hh_keys"][i]
+            hh_c = arrays["slice_hh_counts"][i]
+            slices[key] = {
+                "events": int(arrays["slice_events"][i]),
+                "hll": arrays["slice_hll"][i],
+                "ent": arrays["slice_ent"][i],
+                "hh": [(int(k), int(c)) for k, c in zip(hh_k, hh_c) if k],
+            }
+    return SealedWindow(
+        gadget=header.get("gadget", ""),
+        node=header.get("node", ""),
+        run_id=header.get("run_id", ""),
+        window=int(header.get("window", 0)),
+        start_ts=float(header.get("start_ts", 0.0)),
+        end_ts=float(header.get("end_ts", 0.0)),
+        events=int(header.get("events", 0)),
+        drops=int(header.get("drops", 0)),
+        cms=arrays["cms"],
+        hll=arrays["hll"],
+        ent=arrays["ent"],
+        topk_keys=arrays["topk_keys"],
+        topk_counts=arrays["topk_counts"],
+        slices=slices,
+        names={int(k): v for k, v in (header.get("names") or {}).items()},
+        slices_dropped=int(header.get("slices_dropped", 0)),
+        seq=int(header.get("seq", 0)),
+        digest=header.get("digest", ""),
+    )
+
+
+def header_overlaps(header: dict, *, start_ts: float | None = None,
+                    end_ts: float | None = None,
+                    start_seq: int | None = None,
+                    end_seq: int | None = None,
+                    key: str | None = None) -> bool:
+    """Does one ListWindows header row overlap the requested range/slice?
+    The ONE overlap rule the agent RPC, the store's local reads, and the
+    fan-out client all share — three copies would drift."""
+    if start_ts is not None and float(header.get("end_ts", 0.0)) < start_ts:
+        return False
+    if end_ts is not None and float(header.get("start_ts", 0.0)) > end_ts:
+        return False
+    seq = int(header.get("seq", 0))
+    if start_seq is not None and seq and seq < start_seq:
+        return False
+    if end_seq is not None and seq and seq > end_seq:
+        return False
+    if key and key not in (header.get("keys") or []):
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Merge algebra (query time)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MergedWindows:
+    """Lazy-merged view over N sealed windows — the disaggregation
+    paper's query-side fold. All fields are plain host state so answers
+    render without device round-trips."""
+
+    windows: int
+    nodes: list[str]
+    start_ts: float
+    end_ts: float
+    events: int
+    drops: int
+    cms: np.ndarray | None
+    hll: np.ndarray | None
+    ent: np.ndarray | None
+    candidates: dict[int, int]       # key32 → summed top-k estimate
+    slices: dict[str, dict]
+    names: dict[int, str]
+    skipped: list[str]               # windows dropped from the merge (why)
+
+    def distinct(self) -> float:
+        if self.hll is None:
+            return 0.0
+        return slice_hll_estimate(self.hll)
+
+    def entropy_bits(self) -> float:
+        if self.ent is None:
+            return 0.0
+        return entropy_bits(self.ent)
+
+    def heavy_hitters(self, k: int = 20) -> list[tuple[int, int]]:
+        order = sorted(self.candidates.items(), key=lambda kv: -kv[1])
+        return [(key, int(c)) for key, c in order[:k] if key][:k]
+
+    def slice_answer(self, key: str) -> dict | None:
+        s = self.slices.get(key)
+        if s is None:
+            return None
+        return {
+            "key": key,
+            "events": int(s["events"]),
+            "distinct": slice_hll_estimate(s["hll"]),
+            "entropy_bits": entropy_bits(s["ent"]),
+            "heavy_hitters": sorted(s["hh"].items(),
+                                    key=lambda kv: -kv[1])[:SLICE_HH_K],
+        }
+
+
+def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
+    """Fold sealed windows into one answer: CMS/entropy add, HLL max,
+    top-k candidates union with summed per-window estimates, slices
+    merge key-wise with the same algebra. Windows whose sketch geometry
+    disagrees with the first window's are skipped AND reported — a
+    silent shape coercion would corrupt every estimate downstream."""
+    out = MergedWindows(windows=0, nodes=[], start_ts=0.0, end_ts=0.0,
+                        events=0, drops=0, cms=None, hll=None, ent=None,
+                        candidates={}, slices={}, names={}, skipped=[])
+    for win in windows:
+        if out.cms is not None and (
+                win.cms.shape != out.cms.shape
+                or win.hll.shape != out.hll.shape
+                or win.ent.shape != out.ent.shape):
+            out.skipped.append(
+                f"{win.node}/{win.gadget} window {win.window}: sketch "
+                f"geometry {win.cms.shape}/{win.hll.shape}/{win.ent.shape} "
+                "differs from the merge base")
+            continue
+        if out.cms is None:
+            out.cms = win.cms.astype(np.int64).copy()
+            out.hll = win.hll.copy()
+            out.ent = win.ent.astype(np.float64).copy()
+            out.start_ts, out.end_ts = win.start_ts, win.end_ts
+        else:
+            out.cms += win.cms.astype(np.int64)
+            np.maximum(out.hll, win.hll, out=out.hll)
+            out.ent += win.ent.astype(np.float64)
+            out.start_ts = min(out.start_ts, win.start_ts)
+            out.end_ts = max(out.end_ts, win.end_ts)
+        out.windows += 1
+        if win.node and win.node not in out.nodes:
+            out.nodes.append(win.node)
+        out.events += int(win.events)
+        out.drops += int(win.drops)
+        for key, c in zip(win.topk_keys.tolist(), win.topk_counts.tolist()):
+            if key:
+                out.candidates[key] = out.candidates.get(key, 0) + int(c)
+        out.names.update(win.names or {})
+        for skey, s in win.slices.items():
+            dst = out.slices.get(skey)
+            if dst is None:
+                out.slices[skey] = {
+                    "events": int(s["events"]),
+                    "hll": np.array(s["hll"], dtype=np.uint8, copy=True),
+                    "ent": s["ent"].astype(np.int64).copy(),
+                    "hh": dict(s["hh"]),
+                }
+                continue
+            if dst["hll"].shape != s["hll"].shape or \
+                    dst["ent"].shape != s["ent"].shape:
+                out.skipped.append(
+                    f"{win.node}/{win.gadget} window {win.window}: slice "
+                    f"{skey!r} geometry differs from the merge base")
+                continue
+            dst["events"] += int(s["events"])
+            np.maximum(dst["hll"], s["hll"], out=dst["hll"])
+            dst["ent"] += s["ent"].astype(np.int64)
+            for k, c in s["hh"]:
+                dst["hh"][k] = dst["hh"].get(k, 0) + c
+    return out
+
+
+__all__ = ["MergedWindows", "SLICE_ENT_LOG2_WIDTH", "SLICE_HH_K",
+           "SLICE_HLL_P", "SealedWindow", "SliceSketch", "WINDOW_SCHEMA",
+           "decode_window", "encode_window", "entropy_bits",
+           "header_overlaps", "merge_windows", "slice_hll_estimate",
+           "window_digest"]

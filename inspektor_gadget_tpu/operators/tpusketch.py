@@ -26,12 +26,16 @@ import numpy as np
 
 import jax.numpy as jnp
 
+import jax
+
 from ..columns import col
 from ..gadgets.context import GadgetContext
 from ..gadgets.interface import GadgetDesc
 from ..models.autoencoder import AEConfig, ae_init, ae_score, ae_train_step, normalize_counts
 from ..ops import bundle_init, fold64_to_32
+from ..ops.hll import hll_init, hll_update
 from ..ops.sketches import bundle_digest_jit, bundle_update_jit, decode_digest
+from ..ops.window import wcms_advance, wcms_init, wcms_query, wcms_update
 from ..params import ParamDesc, ParamDescs, Params, TypeHint
 from ..sources.batch import EventBatch
 from ..telemetry import counter, histogram
@@ -65,6 +69,15 @@ _tm_ckpt_fail = counter("ig_tpusketch_checkpoint_failures_total",
                         "failed sketch-state checkpoint attempts")
 
 _ckpt_log = get_logger("ig-tpu.tpusketch")
+
+# window-plane device steps (history sealing): the WindowedCMS ring
+# rotates at each boundary (current slot = this window's CMS) and a
+# fresh HLL per window tracks its distinct stream; entropy and
+# events/drops come as deltas of the cumulative bundle (additive state
+# is exactly subtractable, HLL is not)
+_wcms_update_jit = jax.jit(wcms_update, donate_argnums=0)
+_wcms_advance_jit = jax.jit(wcms_advance, donate_argnums=0)
+_hll_update_jit = jax.jit(hll_update, donate_argnums=0)
 
 
 @dataclasses.dataclass
@@ -177,6 +190,31 @@ class TpuSketch(Operator):
                                   "sequence scorer"),
             ParamDesc(key="harvest-interval", default="1s",
                       type_hint=TypeHint.DURATION),
+            # sketch-history plane: seal one mergeable window per
+            # boundary into the node's sealed-window store (history/)
+            ParamDesc(key="history", default="false", type_hint=TypeHint.BOOL,
+                      description="seal time-windowed sketch snapshots "
+                                  "into the node's history store"),
+            ParamDesc(key="history-interval", default="10s",
+                      type_hint=TypeHint.DURATION,
+                      description="window length; 0 seals one window per "
+                                  "harvest (the deterministic-replay mode)"),
+            ParamDesc(key="history-dir", default="",
+                      description="override the node history area for this "
+                                  "run ($IG_HISTORY_DIR / agent "
+                                  "--history-dir otherwise)"),
+            ParamDesc(key="history-log2-width", default="12",
+                      type_hint=TypeHint.INT,
+                      description="per-window CMS width (the WindowedCMS "
+                                  "ring's table)"),
+            ParamDesc(key="history-slots", default="8",
+                      type_hint=TypeHint.INT,
+                      description="WindowedCMS ring slots (live last-k "
+                                  "window view)"),
+            ParamDesc(key="history-max-slices", default="256",
+                      type_hint=TypeHint.INT,
+                      description="subpopulation slices tracked per window "
+                                  "(overflow dropped and accounted)"),
         ])
 
     def instantiate(self, ctx: GadgetContext, gadget: Any,
@@ -262,10 +300,58 @@ class TpuSketchInstance(OperatorInstance):
         from ..gadgets.top.sketch import SketchStatsSource
         self._stats = SketchStatsSource(ctx.run_id, ctx.desc.full_name)
         self._stats.register()
+        # -- sketch-history plane (sealed windows, history/) --------------
+        self._hist_on = p.get("history").as_bool() if "history" in p else False
+        if self._hist_on:
+            self._hist_interval = (p.get("history-interval").as_duration()
+                                   if "history-interval" in p else 10.0) or 0.0
+            self._hist_dir = (p.get("history-dir").as_string()
+                              if "history-dir" in p else "") or None
+            self._hist_log2w = (p.get("history-log2-width").as_int()
+                                if "history-log2-width" in p else 12)
+            self._hist_slots = (p.get("history-slots").as_int()
+                                if "history-slots" in p else 8)
+            self._hist_max_slices = (p.get("history-max-slices").as_int()
+                                     if "history-max-slices" in p else 256)
+            # replay reseals under the RECORDED identity and clock so the
+            # window digests reproduce byte-identically (the determinism
+            # contract the e2e asserts); live runs use wall time
+            self._hist_gadget = (ctx.extra.get("history_gadget")
+                                 or ctx.desc.full_name)
+            self._hist_clock = (ctx.extra.get("history_clock")
+                                or ctx.extra.get("alerts_clock") or time.time)
+            self._wcms = wcms_init(n_slots=self._hist_slots,
+                                   depth=p.get("depth").as_int(),
+                                   log2_width=self._hist_log2w)
+            self._win_hll = hll_init(p.get("hll-p").as_int())
+            self._win_n = 0
+            self._win_start = self._hist_clock()
+            self._win_events0 = 0.0
+            self._win_drops0 = 0.0
+            self._win_ent0 = np.asarray(self.bundle.entropy.counts).copy()
+            self._win_slices: dict[str, Any] = {}
+            self._win_slices_dropped_keys: set[str] = set()
+            from ..history import HISTORY
+            try:
+                self._hist_writer = HISTORY.writer_for(
+                    self._hist_gadget, node=ctx.extra.get("node", "") or "",
+                    run_id=ctx.run_id,
+                    params=ctx.operator_params.copy_to_map(),
+                    base_dir=self._hist_dir)
+            except (OSError, ValueError) as e:
+                _ckpt_log.warning("history store open failed (sealing "
+                                  "disabled for this run): %r", e)
+                self._hist_on = False
         # checkpoint/resume: keyed by gadget identity so a restarted run
         # (new run_id) finds its predecessor's state
         self._ckpt_key = ctx.desc.full_name.replace("/", "-")
         self._resume()
+        if self._hist_on:
+            # window-open snapshots AFTER resume: window deltas must
+            # exclude the prior state bundle_merge just absorbed
+            self._win_events0 = float(self.bundle.events)
+            self._win_drops0 = float(self.bundle.drops)
+            self._win_ent0 = np.asarray(self.bundle.entropy.counts).copy()
         with _live_mu:
             _live[ctx.run_id] = self
 
@@ -316,6 +402,14 @@ class TpuSketchInstance(OperatorInstance):
                     self.bundle, hh_d, distinct_d, dist_d, mask_d,
                     jnp.float32(max(new_drops, 0)),
                 )
+        if self._hist_on:
+            # window-plane device steps ride the same staged arrays: the
+            # WindowedCMS current slot and the per-window HLL absorb the
+            # batch so a seal reads window-only state
+            w32 = mask_d.astype(jnp.int32)
+            self._wcms = _wcms_update_jit(self._wcms, hh_d, w32)
+            self._win_hll = _hll_update_jit(self._win_hll, distinct_d, mask_d)
+            self._accumulate_slices(batch, n, hh, distinct, dist)
         t2 = time.perf_counter()
         self._m_h2d.observe(t1 - t0)
         self._m_update.observe(t2 - t1)
@@ -340,6 +434,9 @@ class TpuSketchInstance(OperatorInstance):
                 self._names[k32] = name or batch.comm_str(i) or f"0x{k32:08x}"
         if self.anomaly_on:
             self._accumulate_container_dists(batch, n)
+        if self._hist_on and self._hist_interval > 0 and \
+                self._hist_clock() - self._win_start >= self._hist_interval:
+            self.seal_window()
         now = time.monotonic()
         if now - self._last_harvest >= self.harvest_interval:
             self._last_harvest = now
@@ -388,6 +485,112 @@ class TpuSketchInstance(OperatorInstance):
         self.scorer, _ = seq_train_step(self.scorer, toks)
         scores = np.asarray(seq_score(self.scorer, toks))
         return {ns: float(s) for ns, s in zip(ready.keys(), scores)}
+
+    # sketch history: sealed windows (history/) -----------------------------
+
+    def _accumulate_slices(self, batch: EventBatch, n: int,
+                           hh: np.ndarray, distinct: np.ndarray,
+                           dist: np.ndarray) -> None:
+        """Hydra-lite subpopulation accumulation for the open window:
+        per-mntns (container/pod identity), per-kind (syscall), and the
+        mntns×kind cross product, each a small host sketch. Bounded by
+        history-max-slices; overflow is dropped AND accounted in the
+        sealed window's header."""
+        from ..history import SliceSketch
+        mntns = batch.cols["mntns"][:n]
+        kind = batch.cols["kind"][:n]
+        hh_n, distinct_n, dist_n = hh[:n], distinct[:n], dist[:n]
+
+        def feed(key: str, sel: np.ndarray) -> None:
+            s = self._win_slices.get(key)
+            if s is None:
+                if len(self._win_slices) >= self._hist_max_slices:
+                    # count distinct dropped SLICES, not drop attempts —
+                    # one over-cap subpopulation recurring in every
+                    # batch is still one dropped slice
+                    self._win_slices_dropped_keys.add(key)
+                    return
+                s = self._win_slices[key] = SliceSketch()
+            s.update(hh_n[sel], distinct_n[sel], dist_n[sel])
+
+        for ns in np.unique(mntns):
+            sel = mntns == ns
+            feed(f"mntns:{int(ns)}", sel)
+            for k in np.unique(kind[sel]):
+                ksel = sel & (kind == k)
+                feed(f"mntns:{int(ns)}|kind:{int(k)}", ksel)
+        for k in np.unique(kind):
+            feed(f"kind:{int(k)}", kind == k)
+
+    def seal_window(self) -> None:
+        """Seal the open window into the history store: ONE frame, ONE
+        O_APPEND write (a kill mid-seal tears at most this window, and
+        the torn tail is dropped-and-accounted on read). Empty windows
+        (no events since the last seal) are skipped — they carry no
+        state and would bloat the range index."""
+        from ..history import HISTORY, SealedWindow, window_digest
+        end = self._hist_clock()
+        with self._bundle_mu:
+            events = float(self.bundle.events)
+            drops = float(self.bundle.drops)
+            ent_now = np.asarray(self.bundle.entropy.counts).copy()
+            cand = np.asarray(self.bundle.topk.keys).copy()
+        win_events = int(events - self._win_events0)
+        if win_events <= 0 and not self._win_slices:
+            self._win_start = end
+            return
+        # window-only snapshots: the ring's CURRENT slot is this window's
+        # CMS; candidates re-estimated against it give the window top-k
+        cms = np.asarray(self._wcms.slots[self._wcms.epoch])
+        counts = np.asarray(wcms_query(self._wcms, jnp.asarray(cand),
+                                       last_k=1)).astype(np.int64)
+        order = np.argsort(-counts)
+        keep = [(int(cand[i]), int(counts[i])) for i in order
+                if cand[i] != 0 and counts[i] > 0]
+        self._win_n += 1
+        win = SealedWindow(
+            gadget=self._hist_gadget,
+            node=self.ctx.extra.get("node", "") or "",
+            run_id=self.ctx.run_id,
+            window=self._win_n,
+            start_ts=float(self._win_start),
+            end_ts=float(end),
+            events=win_events,
+            drops=int(drops - self._win_drops0),
+            cms=cms.astype(np.int32),
+            hll=np.asarray(self._win_hll.registers).astype(np.int32),
+            ent=(ent_now - self._win_ent0).astype(np.float32),
+            topk_keys=np.array([k for k, _ in keep], dtype=np.uint32),
+            topk_counts=np.array([c for _, c in keep], dtype=np.int64),
+            slices={key: {"events": s.events, "hll": s.hll, "ent": s.ent,
+                          "hh": s.sealed_hh()}
+                    for key, s in self._win_slices.items()},
+            names={k: self._names[k] for k, _ in keep if k in self._names},
+            slices_dropped=len(self._win_slices_dropped_keys),
+        )
+        win.digest = window_digest(win)
+        try:
+            with self._span("tpusketch/seal-window", window=self._win_n,
+                            events=win_events):
+                HISTORY.append_window(win, writer=self._hist_writer)
+        except (OSError, ValueError) as e:
+            if not isinstance(e, OSError):
+                # an OSError was already counted by the writer's append
+                # path (reason="append"); counting it again here would
+                # report two lost windows for one failure
+                from ..history import HISTORY_METRICS
+                HISTORY_METRICS.drops.labels(reason="seal").inc()
+            _ckpt_log.warning("window seal failed (window %d kept in "
+                              "memory was dropped): %r", self._win_n, e)
+        # open the next window: rotate the ring, fresh HLL, new deltas
+        self._wcms = _wcms_advance_jit(self._wcms)
+        self._win_hll = hll_init(self._win_hll.p)
+        self._win_start = end
+        self._win_events0 = events
+        self._win_drops0 = drops
+        self._win_ent0 = ent_now
+        self._win_slices = {}
+        self._win_slices_dropped_keys = set()
 
     # harvest ---------------------------------------------------------------
 
@@ -443,6 +646,11 @@ class TpuSketchInstance(OperatorInstance):
             cb(summary)
         self._m_harvests.inc()
         self._m_harvest_s.observe(time.perf_counter() - t0)
+        if self._hist_on and self._hist_interval <= 0:
+            # history-interval 0: one sealed window per harvest — the
+            # deterministic-replay mode (harvest boundaries are recorded
+            # EV_SUMMARY records, so replay reseals identical windows)
+            self.seal_window()
         return summary
 
     def post_gadget_run(self) -> None:
@@ -453,6 +661,13 @@ class TpuSketchInstance(OperatorInstance):
             # the digest-sequence determinism contract
             if not self.ctx.extra.get("replay"):
                 self.harvest()
+            if self._hist_on:
+                # final partial window (no-op when the last harvest
+                # already sealed it), then seal the store's active
+                # segment so these windows get index rows
+                self.seal_window()
+                from ..history import HISTORY
+                HISTORY.release(self._hist_writer)
             self._stats.unregister()
             if _ckpt_dir is not None:
                 # shutdown save stays best-effort, but failures are now

@@ -144,6 +144,45 @@ class GrpcRuntime(Runtime):
             json.dump(bundle, f, sort_keys=True, indent=2)
         return bundle
 
+    # -- sketch-history fan-out (history/) ----------------------------------
+
+    def list_windows(self, **kw) -> tuple[dict, dict]:
+        """Per-node sealed-window header rows overlapping a range/slice
+        (kw: gadget, start_ts/end_ts, start_seq/end_seq, key)."""
+        return self._fanout_unary(lambda c: c.list_windows(**kw))
+
+    def fetch_windows(self, **kw) -> tuple[dict, dict]:
+        """Per-node (frames, losses) for every matching window. The
+        pull is index-guided: each node is first asked to LIST, and
+        nodes with zero overlapping windows are never asked for bytes."""
+        def pull(c):
+            listing = c.list_windows(**kw)
+            if not listing.get("windows"):
+                return {"frames": [], "losses": listing.get("losses") or []}
+            frames, losses = c.fetch_windows(**kw)
+            return {"frames": frames, "losses": losses}
+        return self._fanout_unary(pull)
+
+    def query_history(self, *, key: str | None = None, top: int = 20,
+                      **kw) -> "Any":
+        """The fleet-wide range query: pull only index-overlapping
+        windows from every node and merge them client-side (the
+        disaggregation fold — bundle_merge's algebra applied to sealed
+        state). Per-node errors are recorded in the answer, never
+        fatal: a crashed node's peers still answer for their share."""
+        from ..history import answer_query, decode_frames
+        results, errors = self.fetch_windows(key=key, **kw)
+        windows = []
+        dropped: list[str] = []
+        for node, res in results.items():
+            windows.extend(decode_frames(res["frames"]))
+            for loss in res["losses"]:
+                dropped.append(f"{node}: torn window tail "
+                               f"({loss.get('reason', '?')}, "
+                               f"{loss.get('dropped_bytes', 0)} bytes)")
+        return answer_query(windows, key=key, top=top, dropped=dropped,
+                            errors=errors)
+
     def run_gadget(
         self,
         ctx: GadgetContext,

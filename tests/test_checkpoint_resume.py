@@ -102,10 +102,15 @@ def test_agent_kill_and_resume(tmp_path):
     env = dict(os.environ)
 
     def spawn():
+        # --platform cpu (the PR-2 flag) pins the spawned agent's device
+        # plane instead of inheriting JAX_PLATFORMS from the test env:
+        # with the TPU tunnel down the inherited-auto probe used to eat
+        # most of the startup deadline and flake this test
         return subprocess.Popen(
             [sys.executable, "-m", "inspektor_gadget_tpu.agent.main",
              "serve", "--listen", addr, "--node-name", "ckpt-node",
-             "--no-doctor", "--checkpoint-dir", str(ckpt),
+             "--no-doctor", "--platform", "cpu",
+             "--checkpoint-dir", str(ckpt),
              "--checkpoint-interval", "0.3"],
             env=env, cwd="/root/repo",
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)

@@ -31,10 +31,29 @@ def test_probe_windows_names_and_shape():
                 "sock_diag", "netlink_proc", "af_packet", "mountinfo",
                 "procfs", "blktrace", "tcpinfo", "audit", "captrace",
                 "fstrace", "sockstate", "sigtrace", "container_runtime",
-                "capture_dir"}
+                "capture_dir", "history_dir"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail
+
+
+def test_history_dir_row_reports_writability_usage_and_free(monkeypatch,
+                                                           tmp_path):
+    """The history plane's doctor row: a writable store area reports its
+    usage and free space; an unwritable one degrades the row, not the
+    probe run (ISSUE 6 satellite)."""
+    monkeypatch.setenv("IG_HISTORY_DIR", str(tmp_path / "hist"))
+    w = probe_windows()["history_dir"]
+    assert w.ok
+    assert "writable" in w.detail and "segment(s)" in w.detail
+    assert "MiB" in w.detail
+    if os.geteuid() != 0:
+        ro = tmp_path / "ro"
+        ro.mkdir()
+        os.chmod(ro, 0o500)
+        monkeypatch.setenv("IG_HISTORY_DIR", str(ro / "hist"))
+        w = probe_windows()["history_dir"]
+        assert not w.ok and "unwritable" in w.detail
 
 
 def test_gadget_report_covers_every_registered_gadget():

@@ -1,0 +1,433 @@
+"""Sketch-history acceptance tier (ISSUE 6):
+
+- a 2-agent GrpcRuntime run with the tpusketch history plane on seals
+  mergeable windows on both nodes (each node's store carries only its
+  own windows),
+- `ig-tpu query` over a seq/ts range pulls only index-overlapping
+  windows from both nodes and merges them client-side, answering
+  cardinality, heavy-hitter, and entropy queries — whole-traffic and
+  for a (key, time-range) subpopulation slice — matching single-merge
+  ground truth within the documented sketch error,
+- a node killed mid-seal leaves exactly one torn window at the store's
+  active tail, dropped-and-accounted on read (the query still answers
+  from the surviving windows and reports the loss),
+- replaying the same PR-5 capture journal reseals windows whose content
+  digests are byte-identical to the live run's — the determinism
+  contract extended from summaries to sealed history.
+"""
+
+from __future__ import annotations
+
+import binascii
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.agent import wire
+from inspektor_gadget_tpu.agent.service import serve
+from inspektor_gadget_tpu.capture import RECORDINGS, replay_journal
+from inspektor_gadget_tpu.gadgets import GadgetContext
+from inspektor_gadget_tpu.gadgets import registry as gadget_registry
+from inspektor_gadget_tpu.gadgets.interface import GadgetDesc, GadgetType
+from inspektor_gadget_tpu.history import HISTORY
+from inspektor_gadget_tpu.operators import operators as op_registry
+from inspektor_gadget_tpu.ops import fold64_to_32
+from inspektor_gadget_tpu.params import Collection, ParamDescs
+
+REC_ID = "history-e2e"
+GADGET = "trace/historysynth"
+
+# deterministic scripted traffic, fixed at import: two tenants (mntns)
+# × two syscalls (kind), a zipf-heavy stream for tenant A and a
+# high-cardinality uniform stream for tenant B
+_RNG = np.random.default_rng(21)
+N_BATCHES = 6
+BATCH = 2048
+
+
+def _zipf(n):
+    return (_RNG.zipf(1.5, size=n).clip(1, 64).astype(np.uint64)
+            * np.uint64(0x9E3779B97F4A7C15))
+
+
+_PHASES = []
+for _i in range(N_BATCHES):
+    a = _zipf(BATCH // 2)                                     # tenant 101
+    b = _RNG.integers(1, 2**48, BATCH // 2).astype(np.uint64)  # tenant 202
+    keys = np.concatenate([a, b])
+    mntns = np.concatenate([np.full(BATCH // 2, 101, np.uint64),
+                            np.full(BATCH // 2, 202, np.uint64)])
+    kind = np.concatenate([np.full(BATCH // 4, 10, np.uint32),
+                           np.full(BATCH // 4, 11, np.uint32),
+                           np.full(BATCH // 2, 11, np.uint32)])
+    _PHASES.append((keys, mntns, kind))
+
+
+def _truth(sel=None):
+    """Exact ground truth over the scripted stream (folded 32-bit keys,
+    the stream the sketches actually absorb)."""
+    keys, counts = [], {}
+    for bkeys, bmntns, _bkind in _PHASES:
+        k32 = fold64_to_32(bkeys)
+        mask = slice(None) if sel is None else (bmntns == sel)
+        for k in k32[mask].tolist():
+            counts[k] = counts.get(k, 0) + 1
+        keys.append(k32[mask])
+    allk = np.concatenate(keys)
+    return {
+        "events": len(allk),
+        "distinct": len(np.unique(allk)),
+        "top": sorted(counts.items(), key=lambda kv: -kv[1]),
+    }
+
+
+class _HistorySynthGadget:
+    """Scripted batches with one explicit harvest per batch: with
+    history-interval 0, every harvest seals a window, so the recorded
+    journal and the live store share deterministic boundaries."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._batch_handler = None
+
+    def set_batch_handler(self, handler):
+        self._batch_handler = handler
+
+    def run(self, ctx):
+        from inspektor_gadget_tpu.operators import tpusketch
+        from inspektor_gadget_tpu.sources.batch import EventBatch
+        inst = next((i for i in tpusketch.live_instances()
+                     if i.ctx.run_id == ctx.run_id), None)
+        for keys, mntns, kind in _PHASES:
+            if ctx.done:
+                return
+            b = EventBatch.alloc(len(keys), with_comm=False)
+            b.cols["key_hash"][:] = keys
+            b.cols["mntns"][:] = mntns
+            b.cols["kind"][:] = kind
+            b.cols["ts"][:] = time.time_ns()
+            b.count = len(keys)
+            if self._batch_handler is not None:
+                self._batch_handler(b)
+            if inst is not None:
+                inst.harvest()
+            ctx.sleep_or_done(0.05)
+
+
+class _HistorySynthDesc(GadgetDesc):
+    name = "historysynth"
+    category = "trace"
+    gadget_type = GadgetType.TRACE
+    description = "scripted two-tenant batch gadget (history e2e)"
+    event_cls = None
+
+    def params(self) -> ParamDescs:
+        return ParamDescs()
+
+    def new_instance(self, ctx) -> _HistorySynthGadget:
+        return _HistorySynthGadget(ctx)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def synth_gadget():
+    desc = _HistorySynthDesc()
+    gadget_registry.register(desc)
+    yield desc
+    gadget_registry._REGISTRY.pop((desc.category, desc.name), None)
+
+
+@pytest.fixture(scope="module")
+def agents():
+    servers, targets = [], {}
+    tmp = tempfile.mkdtemp()
+    for i in range(2):
+        addr = f"unix://{tmp}/hist-agent{i}.sock"
+        server, _ = serve(addr, node_name=f"hnode-{i}")
+        servers.append(server)
+        targets[f"hnode-{i}"] = addr
+    yield targets
+    for s in servers:
+        s.stop(grace=0.5)
+
+
+@pytest.fixture(scope="module")
+def history_area(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("history-area"))
+    HISTORY.set_base_dir(base)
+    yield base
+    HISTORY.close_all()
+    HISTORY.set_base_dir(None)
+
+
+@pytest.fixture(scope="module")
+def capture_area(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("hist-capture"))
+    RECORDINGS.set_base_dir(base)
+    yield base
+    RECORDINGS.set_base_dir(None)
+
+
+def _op_params() -> Collection:
+    col = Collection()
+    sp = op_registry.get("tpusketch").instance_params().to_params()
+    for k, v in (("enable", "true"), ("depth", "4"), ("log2-width", "10"),
+                 ("hll-p", "10"), ("entropy-log2-width", "8"),
+                 ("topk", "32"), ("harvest-interval", "1h"),
+                 ("history", "true"), ("history-interval", "0"),
+                 ("history-log2-width", "12"), ("history-slots", "4")):
+        sp.set(k, v)
+    col["operator.tpusketch."] = sp
+    return col
+
+
+@pytest.fixture(scope="module")
+def recorded_fleet(agents, history_area, capture_area, tmp_path_factory):
+    """Arm a PR-5 recording, run the scripted gadget on both agents with
+    the history plane on, stop, fetch the bundle — the shared journey
+    every test below inspects from a different side."""
+    from inspektor_gadget_tpu.runtime.grpc_runtime import GrpcRuntime
+    runtime = GrpcRuntime(dict(agents))
+    try:
+        results, errors = runtime.start_recording(REC_ID)
+        assert not errors, errors
+        desc = gadget_registry.get("trace", "historysynth")
+        ctx = GadgetContext(desc, operator_params=_op_params(), timeout=120.0)
+        run = runtime.run_gadget(ctx)
+        assert not run.errors(), run.errors()
+        _, stop_errors = runtime.stop_recording(REC_ID)
+        assert not stop_errors, stop_errors
+        bundle_dir = str(tmp_path_factory.mktemp("hist-bundle"))
+        bundle = runtime.fetch_recording(REC_ID, bundle_dir)
+        assert not bundle["errors"], bundle["errors"]
+    finally:
+        runtime.close()
+    return {"bundle_dir": bundle_dir}
+
+
+def test_both_nodes_sealed_their_own_windows(recorded_fleet, agents,
+                                             history_area):
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    for node, target in agents.items():
+        c = AgentClient(target, node)
+        try:
+            listing = c.list_windows(gadget=GADGET)
+            rows = listing["windows"]
+            # one window per scripted batch, served per node: an agent
+            # never hands out a peer's windows even though the
+            # in-process fleet shares one base area
+            assert len(rows) == N_BATCHES, (node, len(rows))
+            assert {r["node"] for r in rows} == {node}
+            assert [r["window"] for r in rows] == list(range(1, N_BATCHES + 1))
+            assert all(r["digest"] for r in rows)
+            # subpopulation keys ride the headers (and the index)
+            assert {"mntns:101", "mntns:202", "kind:10", "kind:11",
+                    "mntns:101|kind:10"} <= set(rows[0]["keys"])
+        finally:
+            c.close()
+
+
+def test_range_query_matches_single_merge_ground_truth(recorded_fleet,
+                                                       agents):
+    from inspektor_gadget_tpu.runtime.grpc_runtime import GrpcRuntime
+    runtime = GrpcRuntime(dict(agents))
+    try:
+        ans = runtime.query_history(gadget=GADGET)
+        # both nodes ran the same script: 2 × the scripted stream
+        truth = _truth()
+        assert ans.windows == 2 * N_BATCHES
+        assert sorted(ans.nodes) == sorted(agents)
+        assert ans.events == 2 * truth["events"]
+        # cardinality: both nodes saw the SAME keys, so distinct stays
+        # ~truth (HLL p=10 documents ~3.3% standard error)
+        assert abs(ans.distinct - truth["distinct"]) / truth["distinct"] \
+            < 0.12, (ans.distinct, truth["distinct"])
+        # heavy hitters: the zipf head must surface, counts within CMS
+        # overestimate-only error (≤ ~1% at this width)
+        got = dict((k, c) for k, c, _label in ans.heavy_hitters)
+        for true_key, true_count in truth["top"][:5]:
+            assert true_key in got, hex(true_key)
+            est = got[true_key]
+            assert 2 * true_count <= est <= 2 * true_count * 1.02 + 8, (
+                hex(true_key), est, 2 * true_count)
+        assert ans.entropy_bits > 0
+
+        # (key, time-range) slice: tenant 101 over the middle windows
+        listing, errors = runtime.list_windows(gadget=GADGET)
+        assert not errors
+        rows = listing["hnode-0"]["windows"]
+        # consecutive windows touch (window k's start == k-1's end), and
+        # overlap is inclusive of touching/straddling windows — pick
+        # bounds strictly inside the interior so the ends are pruned
+        t0 = rows[1]["end_ts"] + 1e-4          # excludes windows 1..2
+        t1 = rows[4]["start_ts"] - 1e-4        # excludes windows 5..6
+        sliced = runtime.query_history(gadget=GADGET, key="mntns:101",
+                                       start_ts=t0, end_ts=t1)
+        # hnode-1's windows carry different wall times; assert only the
+        # range restriction pruned SOME windows and kept the slice exact
+        assert 0 < sliced.windows < 2 * N_BATCHES
+        s = sliced.slices["mntns:101"]
+        # slice events are exact (counted, not sketched): 1024 per
+        # window per node within the range
+        assert s["events"] % (BATCH // 2) == 0 and s["events"] > 0
+        truth_a = _truth(sel=101)
+        # tenant A's slice cardinality, within the p=8 slice HLL's
+        # documented error envelope (~6.5% σ; allow 3σ)
+        assert abs(s["distinct"] - truth_a["distinct"]) \
+            / truth_a["distinct"] < 0.25, (s["distinct"],
+                                           truth_a["distinct"])
+        # tenant A's heavy head is exact per-slice (truncated table)
+        slice_top = {h["key"] for h in s["heavy_hitters"][:3]}
+        want_top = {f"0x{k:08x}" for k, _ in truth_a["top"][:3]}
+        assert want_top & slice_top, (slice_top, want_top)
+        # entropy: tenant A is zipf-skewed, the whole stream is not —
+        # the slice answer must show visibly LESS entropy
+        assert s["entropy_bits"] < ans.entropy_bits
+    finally:
+        runtime.close()
+
+
+def test_seq_range_prunes_windows(recorded_fleet, agents):
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    node, target = next(iter(agents.items()))
+    c = AgentClient(target, node)
+    try:
+        rows = c.list_windows(gadget=GADGET, start_seq=3,
+                              end_seq=4)["windows"]
+        assert [r["seq"] for r in rows] == [3, 4]
+        frames, losses = c.fetch_windows(gadget=GADGET, start_seq=3,
+                                         end_seq=4)
+        assert len(frames) == 2 and not losses
+    finally:
+        c.close()
+
+
+def test_kill_mid_seal_tears_exactly_one_window(recorded_fleet, agents,
+                                                history_area):
+    """A SIGKILLed node mid-seal: its store's active segment ends in a
+    half-written window frame. Readers drop exactly that window,
+    account the loss, and the fleet query still answers."""
+    from inspektor_gadget_tpu.runtime.grpc_runtime import GrpcRuntime
+    store = os.path.join(history_area, "hnode-0--trace-historysynth")
+    segs = sorted(f for f in os.listdir(store) if f.endswith(".igj"))
+    seg = os.path.join(store, segs[-1])
+    header = {"type": wire.EV_WINDOW, "seq": 10_000, "ts": time.time(),
+              "gadget": GADGET, "node": "hnode-0", "window": 99,
+              "start_ts": 0.0, "end_ts": 9e12, "events": 1, "keys": []}
+    zp = zlib.compress(wire.encode_msg(header, b"x" * 512), 1)
+    frame = (len(zp).to_bytes(4, "little")
+             + (zlib.crc32(zp) & 0xFFFFFFFF).to_bytes(4, "little") + zp)
+    child = subprocess.Popen([
+        sys.executable, "-c",
+        "import binascii, os, signal, sys\n"
+        "f = open(sys.argv[1], 'ab')\n"
+        "f.write(binascii.unhexlify(sys.argv[2]))\n"
+        "f.flush(); os.fsync(f.fileno())\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n",
+        seg, binascii.hexlify(frame[: len(frame) // 2]).decode(),
+    ])
+    child.wait(timeout=30)
+    assert child.returncode == -signal.SIGKILL
+    try:
+        losses: list = []
+        rows = HISTORY.list_windows(gadget=GADGET, node="hnode-0",
+                                    losses=losses)
+        # every whole window survives; exactly ONE torn window accounted
+        assert len(rows) == N_BATCHES
+        assert len(losses) == 1
+        assert losses[0]["dropped_bytes"] == len(frame) // 2
+        # the fleet query reports the loss and still answers
+        runtime = GrpcRuntime(dict(agents))
+        try:
+            ans = runtime.query_history(gadget=GADGET)
+        finally:
+            runtime.close()
+        assert ans.windows == 2 * N_BATCHES
+        assert any("torn window tail" in d for d in ans.dropped_windows)
+    finally:
+        # heal the segment for the tests that follow
+        with open(seg, "r+b") as f:
+            f.seek(0, os.SEEK_END)
+            f.truncate(f.tell() - len(frame) // 2)
+
+
+def test_replay_reseals_byte_identical_window_digests(recorded_fleet,
+                                                      history_area,
+                                                      tmp_path):
+    """The determinism anchor: re-driving the PR-5 capture journal
+    through the real chain reseals windows whose content digests are
+    byte-identical to the live run's, twice over."""
+    bundle_dir = recorded_fleet["bundle_dir"]
+    node = "hnode-0"
+    live = HISTORY.list_windows(gadget=GADGET, node=node)
+    live_digests = [r["digest"] for r in live]
+    assert len(live_digests) == N_BATCHES
+
+    from inspektor_gadget_tpu.capture import JournalReader, is_journal
+    root = os.path.join(bundle_dir, node)
+    jpath = next(os.path.join(root, d) for d in sorted(os.listdir(root))
+                 if is_journal(os.path.join(root, d))
+                 and JournalReader(os.path.join(root, d)).manifest
+                 .get("node") == node)
+
+    digests = []
+    for attempt in range(2):
+        replay_dir = str(tmp_path / f"replay-hist-{attempt}")
+        res = replay_journal(jpath, speed=0.0, param_overrides={
+            "operator.tpusketch.history-dir": replay_dir})
+        assert res.digests_match  # the PR-5 summary contract still holds
+        rows = HISTORY.list_windows(base_dir=replay_dir, gadget=GADGET)
+        digests.append([r["digest"] for r in rows])
+    assert digests[0] == digests[1], "replay-to-replay digest drift"
+    assert digests[0] == live_digests, "replay diverged from the live seal"
+
+
+def test_query_cli_remote_and_local(recorded_fleet, agents, history_area,
+                                    capsys):
+    from inspektor_gadget_tpu.cli.main import main as cli_main
+    spec = ",".join(f"{k}={v}" for k, v in agents.items())
+    assert cli_main(["query", "--remote", spec, "--gadget", GADGET,
+                     "--key", "mntns:101", "--top", "3"]) == 0
+    out = capsys.readouterr().out
+    assert f"{2 * N_BATCHES} window(s)" in out
+    assert "slice mntns:101:" in out
+    assert "distinct≈" in out and "entropy=" in out
+
+    # JSON output carries the full answer shape
+    assert cli_main(["query", "--remote", spec, "--gadget", GADGET,
+                     "-o", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["windows"] == 2 * N_BATCHES
+    assert doc["errors"] == {}
+    assert doc["heavy_hitters"]
+
+    # the local path reads the node area directly (no agents)
+    assert cli_main(["query", "--history", history_area,
+                     "--gadget", GADGET]) == 0
+    out = capsys.readouterr().out
+    assert f"{2 * N_BATCHES} window(s)" in out
+
+
+def test_top_windows_gadget_lists_sealed_windows(recorded_fleet,
+                                                 history_area):
+    from inspektor_gadget_tpu.gadgets import get
+    from inspektor_gadget_tpu.runtime.local import LocalRuntime
+    desc = get("top", "windows")
+    params = desc.params().to_params()
+    params.set("interval", "200ms")
+    ctx = GadgetContext(desc, gadget_params=params, timeout=0.5)
+    snapshots = []
+    result = LocalRuntime().run_gadget(ctx, on_event_array=snapshots.append)
+    assert not result.errors(), result.errors()
+    rows = [r for snap in snapshots for r in snap
+            if r.gadget == GADGET]
+    assert rows, "top windows never showed the sealed history"
+    assert any(r.events > 0 and r.slices > 0 for r in rows)

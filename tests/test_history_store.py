@@ -1,0 +1,292 @@
+"""Sketch-history store tier: window codec roundtrip, digest
+determinism, torn-window accounting, index-key pruning, retention GC,
+slice sketch accuracy, and the merge algebra's failure accounting.
+
+The e2e contract (2-agent range queries, kill-mid-seal, replay digest
+reproduction) lives in tests/test_history_query_e2e.py; this file pins
+the store and codec invariants those journeys rest on.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from inspektor_gadget_tpu.agent import wire
+from inspektor_gadget_tpu.history import (
+    HISTORY,
+    HistoryStore,
+    SealedWindow,
+    SliceSketch,
+    answer_query,
+    decode_frames,
+    decode_window,
+    encode_window,
+    header_overlaps,
+    merge_windows,
+    pack_frames,
+    unpack_frames,
+    validate_store_name,
+    window_digest,
+)
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = HistoryStore()
+    s.set_base_dir(str(tmp_path))
+    yield s
+    s.close_all()
+
+
+def _window(i: int, *, keys: np.ndarray | None = None, gadget="trace/exec",
+            node="n0", slices=True, width=64) -> SealedWindow:
+    rng = np.random.default_rng(i)
+    if keys is None:
+        keys = rng.integers(1, 500, 256, dtype=np.uint32)
+    sl = {}
+    if slices:
+        s = SliceSketch()
+        s.update(keys, keys, keys)
+        sl[f"mntns:{i % 2}"] = {"events": s.events, "hll": s.hll,
+                                "ent": s.ent, "hh": s.sealed_hh()}
+    w = SealedWindow(
+        gadget=gadget, node=node, run_id="r", window=i,
+        start_ts=1000.0 + i, end_ts=1001.0 + i,
+        events=len(keys), drops=i % 3,
+        cms=rng.integers(0, 9, (4, width)).astype(np.int32),
+        hll=rng.integers(0, 5, 256).astype(np.int32),
+        ent=rng.integers(0, 9, 64).astype(np.float32),
+        topk_keys=np.array([1, 2, 3], np.uint32),
+        topk_counts=np.array([30, 20, 10], np.int64),
+        slices=sl, names={1: "bash"})
+    w.digest = window_digest(w)
+    return w
+
+
+# -- codec -------------------------------------------------------------------
+
+def test_window_roundtrip_and_digest_stability():
+    w = _window(1)
+    header, payload = encode_window(w)
+    back = decode_window({**header, "seq": 42}, payload)
+    assert back.seq == 42
+    assert back.events == w.events and back.drops == w.drops
+    assert np.array_equal(back.cms, w.cms)
+    assert np.array_equal(back.hll, w.hll)
+    assert back.slices.keys() == w.slices.keys()
+    assert back.names == {1: "bash"}
+    # digest is over decoded VALUES, so it survives the codec and does
+    # NOT depend on wall timestamps (the replay-determinism anchor)
+    assert window_digest(back) == w.digest
+    shifted = decode_window({**header, "start_ts": 9e9, "end_ts": 9e9},
+                            payload)
+    assert window_digest(shifted) == w.digest
+    # ...but any state change shows
+    back.events += 1
+    assert window_digest(back) != w.digest
+
+
+def test_header_overlap_rule():
+    h = {"start_ts": 10.0, "end_ts": 20.0, "seq": 5, "keys": ["mntns:1"]}
+    assert header_overlaps(h)
+    assert header_overlaps(h, start_ts=15.0)          # straddles
+    assert not header_overlaps(h, start_ts=20.5)
+    assert not header_overlaps(h, end_ts=9.0)
+    assert header_overlaps(h, start_seq=5, end_seq=5)
+    assert not header_overlaps(h, start_seq=6)
+    assert header_overlaps(h, key="mntns:1")
+    assert not header_overlaps(h, key="mntns:2")
+
+
+# -- store -------------------------------------------------------------------
+
+def test_append_list_fetch_roundtrip(store, tmp_path):
+    w = store.writer_for("trace/exec", node="n0")
+    for i in range(1, 4):
+        store.append_window(_window(i), writer=w)
+    store.release(w)
+    rows = store.list_windows()
+    assert [r["window"] for r in rows] == [1, 2, 3]
+    assert all(r["digest"] for r in rows)
+    # seq/ts range restriction
+    assert len(store.list_windows(start_ts=1002.5)) == 2
+    assert len(store.list_windows(start_seq=3)) == 1
+    # slice-key restriction (odd windows carry mntns:1)
+    assert [r["window"] for r in store.list_windows(key="mntns:1")] == [1, 3]
+    frames = list(store.fetch_windows(key="mntns:0"))
+    wins = decode_frames(frames)
+    assert [x.window for x in wins] == [2]
+
+
+def test_range_end_keeps_straddling_window(store, tmp_path):
+    """Regression (review finding): a window straddling the query's END
+    bound must be included — the frame ts is the window's end_ts, and
+    pushing end_ts into the reader's per-record filter silently dropped
+    exactly the window that overlaps the range end."""
+    w = store.writer_for("trace/exec", node="n0")
+    win = _window(1)
+    win.start_ts, win.end_ts = 10.0, 20.0
+    store.append_window(win, writer=w)
+    rows = store.list_windows(start_ts=5.0, end_ts=15.0)
+    assert [r["window"] for r in rows] == [1]
+    # and a range strictly before/after still excludes it
+    assert store.list_windows(end_ts=9.0) == []
+    assert store.list_windows(start_ts=20.5) == []
+
+
+def test_index_rows_carry_slice_keys_and_window_counts(store, tmp_path):
+    from inspektor_gadget_tpu.utils.journal import read_jsonl
+    w = store.writer_for("trace/exec", node="n0")
+    for i in range(1, 4):
+        store.append_window(_window(i), writer=w)
+    store.release(w)  # seals the active segment
+    rows = read_jsonl(
+        str(tmp_path / "n0--trace-exec" / "index.jsonl")).records
+    assert rows
+    assert rows[-1]["windows"] == 3
+    assert set(rows[-1]["keys"]) == {"mntns:0", "mntns:1"}
+
+
+def test_torn_window_dropped_and_accounted(store, tmp_path):
+    """A kill mid-seal leaves exactly one torn window at the active
+    segment's tail: readers drop it, account it, and every earlier
+    window survives."""
+    w = store.writer_for("trace/exec", node="n0")
+    for i in range(1, 4):
+        store.append_window(_window(i), writer=w)
+    seg = tmp_path / "n0--trace-exec" / "seg-00000001.igj"
+    header, payload = encode_window(_window(4))
+    zp = zlib.compress(wire.encode_msg(
+        {**header, "type": wire.EV_WINDOW, "seq": 4, "ts": 0.0}, payload), 1)
+    torn = (len(zp).to_bytes(4, "little")
+            + (zlib.crc32(zp) & 0xFFFFFFFF).to_bytes(4, "little") + zp)
+    with open(seg, "ab") as f:
+        f.write(torn[: len(torn) // 2])
+    losses: list = []
+    rows = store.list_windows(losses=losses)
+    assert [r["window"] for r in rows] == [1, 2, 3]
+    assert len(losses) == 1
+    assert losses[0]["dropped_bytes"] == len(torn) // 2
+    # reopening the store for writing truncates the tear and continues
+    store.close_all()
+    w2 = store.writer_for("trace/exec", node="n0")
+    seq = store.append_window(_window(5), writer=w2)
+    assert seq == 4  # continues after the last GOOD window
+    assert [r["window"] for r in store.list_windows()] == [1, 2, 3, 5]
+
+
+def test_retention_gc_never_touches_active_segment(store, tmp_path):
+    rng = np.random.default_rng(0)
+    w = store.writer_for(
+        "trace/exec", node="n0",
+        max_segment_bytes=1 << 12, max_segment_age=0, retention_segments=1)
+    for i in range(1, 7):
+        big = rng.integers(1, 2**30, 2048, dtype=np.uint32)
+        win = _window(i, slices=False, width=512)
+        win.cms = big.reshape(4, 512).astype(np.int32)
+        store.append_window(win, writer=w)
+    segs = sorted(os.listdir(tmp_path / "n0--trace-exec"))
+    seg_files = [s for s in segs if s.startswith("seg-")]
+    # GC bounded the sealed history to 1 + the active segment
+    assert len(seg_files) <= 2
+    # the ACTIVE (highest-numbered) segment always survives
+    assert seg_files[-1] == sorted(seg_files)[-1]
+    rows = store.list_windows()
+    assert rows, "GC must never empty the store"
+
+
+def test_store_name_guard():
+    for bad in ("", ".", "..", "a/b", "/abs"):
+        with pytest.raises(ValueError):
+            validate_store_name(bad)
+    assert validate_store_name("trace-exec") == "trace-exec"
+
+
+# -- pack/unpack (the FetchWindows chunk format) -----------------------------
+
+def test_pack_unpack_tolerates_truncated_tail():
+    frames = [encode_window(_window(i)) for i in (1, 2, 3)]
+    blob = pack_frames([({**h, "seq": i + 1}, p)
+                        for i, (h, p) in enumerate(frames)])
+    back, dropped = unpack_frames(blob)
+    assert len(back) == 3 and dropped == 0
+    cut, dropped = unpack_frames(blob[: len(blob) - 7])
+    assert len(cut) == 2 and dropped > 0
+
+
+# -- merge accounting --------------------------------------------------------
+
+def test_merge_skips_and_reports_geometry_mismatch():
+    a, b = _window(1), _window(2)
+    odd = _window(3, width=128)  # different CMS geometry
+    merged = merge_windows([a, b, odd])
+    assert merged.windows == 2
+    assert len(merged.skipped) == 1 and "geometry" in merged.skipped[0]
+    ans = answer_query([a, b, odd])
+    assert ans.windows == 2 and ans.dropped_windows
+
+
+def test_slice_sketch_answers_within_documented_error():
+    """Slice cardinality/entropy from sealed state vs ground truth: the
+    p=8 slice HLL documents ~6.5% standard error (worse in the linear-
+    counting crossover), entropy is near-exact for < 64 distinct."""
+    rng = np.random.default_rng(5)
+    keys = rng.integers(1, 17, 20_000, dtype=np.uint32)  # 16 distinct
+    s = SliceSketch()
+    for i in range(0, len(keys), 4096):
+        chunk = keys[i:i + 4096]
+        s.update(chunk, chunk, chunk)
+    w = _window(1, slices=False)
+    w.slices["mntns:7"] = {"events": s.events, "hll": s.hll, "ent": s.ent,
+                           "hh": s.sealed_hh()}
+    ans = answer_query([w], key="mntns:7").slices["mntns:7"]
+    assert ans["events"] == len(keys)
+    assert abs(ans["distinct"] - 16) / 16 < 0.2
+    # 16 equiprobable keys → 4 bits, biased down only by the (rare at
+    # 16-in-64 occupancy) bucket collisions
+    assert abs(ans["entropy_bits"] - 4.0) < 0.35
+    hh_keys = {h["key"] for h in ans["heavy_hitters"]}
+    assert len(hh_keys) >= 10  # truncated-exact table kept the heavy keys
+
+
+def test_slice_merge_across_windows_equals_single_pass():
+    """Slice HLL max-merge and entropy add across windows reproduce the
+    single-pass slice sketch exactly (the mergeability property the
+    whole plane rests on, asserted at the slice tier too)."""
+    rng = np.random.default_rng(6)
+    keys = rng.integers(1, 4000, 30_000, dtype=np.uint32)
+    single = SliceSketch()
+    single.update(keys, keys, keys)
+    wins = []
+    for i, chunk in enumerate(np.array_split(keys, 5)):
+        s = SliceSketch()
+        s.update(chunk, chunk, chunk)
+        w = _window(i + 1, slices=False)
+        w.slices["kind:1"] = {"events": s.events, "hll": s.hll,
+                              "ent": s.ent, "hh": s.sealed_hh()}
+        wins.append(w)
+    merged = merge_windows(wins)
+    got = merged.slices["kind:1"]
+    assert np.array_equal(got["hll"], single.hll)
+    assert np.array_equal(got["ent"], single.ent.astype(np.int64))
+    assert got["events"] == len(keys)
+
+
+def test_global_history_singleton_is_isolated_by_base_dir(tmp_path):
+    HISTORY.set_base_dir(str(tmp_path / "a"))
+    try:
+        w = HISTORY.writer_for("trace/exec", node="n0")
+        HISTORY.append_window(_window(1), writer=w)
+        assert HISTORY.list_windows()
+        # another base sees nothing
+        assert HISTORY.list_windows(base_dir=str(tmp_path / "b")) == []
+        st = HISTORY.stats()
+        assert st["stores"]["n0--trace-exec"]["windows"] == 1
+        assert st["bytes"] > 0
+    finally:
+        HISTORY.close_all()
+        HISTORY.set_base_dir(None)

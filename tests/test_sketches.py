@@ -217,6 +217,114 @@ def test_windowed_cms_ttl_semantics():
     assert q[0] == 0
 
 
+def test_wcms_merge_associative_and_commutative():
+    """The history plane's lazy query-time fold reorders and regroups
+    merges freely (per-node, per-window, chunked fetches) — legal only
+    because slot-wise merge is a commutative monoid. Assert it on real
+    updated states, not axioms."""
+    from inspektor_gadget_tpu.ops.window import (
+        wcms_init, wcms_merge, wcms_update)
+
+    rng = np.random.default_rng(11)
+    states = []
+    for _ in range(3):
+        s = wcms_init(n_slots=4, depth=4, log2_width=10)
+        s = wcms_update(s, jnp.asarray(zipf_keys(rng, 2048)))
+        states.append(s)
+    a, b, c = states
+    ab_c = wcms_merge(wcms_merge(a, b), c)
+    a_bc = wcms_merge(a, wcms_merge(b, c))
+    assert jnp.array_equal(ab_c.slots, a_bc.slots)
+    ba = wcms_merge(b, a)
+    ab = wcms_merge(a, b)
+    assert jnp.array_equal(ab.slots, ba.slots)
+
+
+def test_wcms_psum_equals_pairwise_merge():
+    """Cluster-wide wcms_psum over a named axis must agree with the
+    host-side pairwise merge — the two merge paths (device all-reduce
+    vs client-side fold over fetched windows) may never diverge."""
+    from inspektor_gadget_tpu.ops.window import (
+        wcms_init, wcms_merge, wcms_psum, wcms_update)
+
+    rng = np.random.default_rng(12)
+    a = wcms_update(wcms_init(n_slots=2, depth=4, log2_width=10),
+                    jnp.asarray(zipf_keys(rng, 1024)))
+    b = wcms_update(wcms_init(n_slots=2, depth=4, log2_width=10),
+                    jnp.asarray(zipf_keys(rng, 1024)))
+    stacked = jax.tree.map(lambda x, y: jnp.stack([x, y]), a, b)
+    out = jax.vmap(lambda s: wcms_psum(s, "nodes"),
+                   axis_name="nodes")(stacked)
+    want = wcms_merge(a, b)
+    assert jnp.array_equal(out.slots[0], want.slots)
+    assert jnp.array_equal(out.slots[1], want.slots)
+
+
+def test_range_query_answers_are_split_invariant():
+    """Order-invariance over random window splits: the same key stream
+    chopped into arbitrary per-window sketches and merged in any
+    grouping must answer range queries like one single-pass sketch —
+    exactly for the additive planes (CMS/entropy), within documented
+    sketch error for HLL."""
+    from inspektor_gadget_tpu.history import merge_windows
+    from inspektor_gadget_tpu.history.window import SealedWindow
+    from inspektor_gadget_tpu.ops.entropy import (
+        entropy_estimate, entropy_init, entropy_update)
+    from inspektor_gadget_tpu.ops.hll import hll_estimate, hll_init, hll_update
+
+    rng = np.random.default_rng(13)
+    keys = zipf_keys(rng, 60_000, vocab=3000)
+
+    def window_of(chunk: np.ndarray, i: int) -> SealedWindow:
+        cms = cms_update(cms_init(4, 12), jnp.asarray(chunk))
+        h = hll_update(hll_init(10), jnp.asarray(chunk))
+        e = entropy_update(entropy_init(8), jnp.asarray(chunk))
+        uniq, counts = np.unique(chunk, return_counts=True)
+        order = np.argsort(-counts)[:16]
+        return SealedWindow(
+            gadget="t", node="n", run_id="r", window=i,
+            start_ts=float(i), end_ts=float(i + 1),
+            events=len(chunk), drops=0,
+            cms=np.asarray(cms.table), hll=np.asarray(h.registers),
+            ent=np.asarray(e.counts),
+            topk_keys=uniq[order].astype(np.uint32),
+            topk_counts=counts[order].astype(np.int64),
+            slices={})
+
+    # ground truth: ONE sketch over the whole stream
+    truth = window_of(keys, 0)
+    true_distinct = len(np.unique(keys))
+
+    # random splits, merged in shuffled order and random groupings
+    for trial in range(3):
+        trng = np.random.default_rng(100 + trial)
+        cuts = np.sort(trng.choice(np.arange(1, len(keys)),
+                                   size=trng.integers(3, 9), replace=False))
+        chunks = np.split(keys, cuts)
+        wins = [window_of(c, i) for i, c in enumerate(chunks) if len(c)]
+        trng.shuffle(wins)
+        # random grouping: fold a random prefix first, then the rest
+        k = int(trng.integers(1, len(wins))) if len(wins) > 1 else 1
+        merged = merge_windows(
+            [w for grp in (wins[:k], wins[k:]) for w in grp])
+        assert not merged.skipped
+        # additive planes reproduce the single-pass sketch EXACTLY
+        assert np.array_equal(merged.cms, truth.cms.astype(np.int64))
+        assert np.array_equal(merged.ent, truth.ent.astype(np.float64))
+        assert merged.events == len(keys)
+        # HLL max-merge over a partition reproduces the single-pass
+        # registers EXACTLY (max over sub-maxima = max over all), so the
+        # merged answer IS the single-merge ground truth; the estimate
+        # itself sits within the p=10 sketch's documented ~3.3% error
+        assert np.array_equal(merged.hll, truth.hll)
+        est = merged.distinct()
+        assert abs(est - true_distinct) / true_distinct < 0.1, (
+            trial, est, true_distinct)
+        single = merge_windows([truth])
+        assert abs(merged.entropy_bits() - single.entropy_bits()) < 1e-9
+        assert est == single.distinct()
+
+
 def test_windowed_cms_merge_and_jit():
     import jax as _jax
     from inspektor_gadget_tpu.ops.window import (

@@ -405,3 +405,63 @@ def test_checkpoint_snapshots_bundle_under_update_pressure(sketch_instance):
     assert not errors
     assert saves > 0
     assert (_tmp / "trace-exec.npz").exists()
+
+
+# ---------------------------------------------------------------------------
+# sketch-history plane telemetry (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+def test_history_counters_and_active_store_gauge(tmp_path):
+    """Sealing windows must account into the history plane's OWN
+    counters (ig_history_*), visible in the Prometheus exposition, with
+    the active-store gauge tracking open writers — and never launder
+    through the capture plane's ig_capture_* family."""
+    import numpy as np
+
+    from inspektor_gadget_tpu.history import HISTORY, SealedWindow
+    from inspektor_gadget_tpu.history.store import HISTORY_METRICS
+    from inspektor_gadget_tpu.telemetry import render_prometheus
+
+    windows_before = HISTORY_METRICS.records.labels(type="9").value
+    bytes_before = HISTORY_METRICS.bytes.value
+    gc_before = HISTORY_METRICS.gc.value
+    active_before = HISTORY_METRICS.active.value
+
+    rng = np.random.default_rng(9)
+
+    def win(i):
+        # random tables defeat zlib so every frame exceeds the 4 KiB
+        # segment floor and rotation/GC fire deterministically
+        return SealedWindow(
+            gadget="trace/telemetry-probe", node="n0", run_id="r",
+            window=i, start_ts=float(i), end_ts=float(i + 1),
+            events=10, drops=0,
+            cms=rng.integers(0, 2**30, (4, 512)).astype(np.int32),
+            hll=np.zeros(16, np.int32),
+            ent=np.zeros(8, np.float32),
+            topk_keys=np.array([1], np.uint32),
+            topk_counts=np.array([5], np.int64), slices={})
+
+    # tight rotation + retention so GC fires deterministically
+    w = HISTORY.writer_for("trace/telemetry-probe",
+                           base_dir=str(tmp_path),
+                           max_segment_bytes=1 << 12, max_segment_age=0,
+                           retention_segments=1)
+    try:
+        for i in range(1, 6):
+            HISTORY.append_window(win(i), writer=w)
+    finally:
+        HISTORY.close_all()
+
+    assert HISTORY_METRICS.records.labels(type="9").value == \
+        windows_before + 5
+    assert HISTORY_METRICS.bytes.value > bytes_before
+    assert HISTORY_METRICS.gc.value > gc_before, \
+        "retention GC of sealed history segments was not counted"
+    assert HISTORY_METRICS.active.value == active_before  # open+close net 0
+
+    text = render_prometheus()
+    assert "ig_history_windows_total" in text
+    assert "ig_history_bytes_total" in text
+    assert "ig_history_gc_total" in text
+    assert "ig_history_active_stores" in text

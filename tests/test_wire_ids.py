@@ -17,6 +17,36 @@ def test_repo_wire_ids_are_registered_and_unique():
     assert not violations, "\n".join(violations)
 
 
+def test_history_window_id_registered():
+    """EV_WINDOW (the sealed-window record the history plane journals
+    and the agents serve) must ride the one authoritative table like
+    every other plane's wire id — and must not collide with the capture
+    plane's EV_JOURNAL_MARK it sits next to."""
+    from inspektor_gadget_tpu.agent import wire
+    assert wire.WIRE_EVENT_IDS["EV_WINDOW"] == wire.EV_WINDOW
+    assert wire.EV_WINDOW != wire.EV_JOURNAL_MARK
+    assert 0 < wire.EV_WINDOW < (1 << wire.EV_LOG_SHIFT)
+
+
+def test_checker_would_catch_unregistered_window_id():
+    """The drift mode PR 6 could have introduced: hand-assigning the new
+    plane's id without registering it fails the gate."""
+    src = _src("""
+        EV_JOURNAL_MARK = 8
+        EV_WINDOW = 9
+        WIRE_EVENT_IDS = {"EV_JOURNAL_MARK": EV_JOURNAL_MARK}
+    """)
+    assert any("EV_WINDOW" in v and "not registered" in v
+               for v in check_source(src, "w.py"))
+    collide = _src("""
+        EV_JOURNAL_MARK = 8
+        EV_WINDOW = 8
+        WIRE_EVENT_IDS = {"EV_JOURNAL_MARK": EV_JOURNAL_MARK,
+                          "EV_WINDOW": EV_WINDOW}
+    """)
+    assert any("multiple constants" in v for v in check_source(collide, "w.py"))
+
+
 def test_runtime_table_matches_module_constants():
     from inspektor_gadget_tpu.agent import wire
     for name, value in wire.WIRE_EVENT_IDS.items():
