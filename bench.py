@@ -16,13 +16,15 @@ JSON line is printed and the exit code is 0; failures are recorded in
 extra.error instead of a stack trace.
 
 Method (the honest pipeline, not device-plane-only): a host producer thread
-runs the C++ synthetic source (zipf exec tuples, FNV-hashed keys — the
-capture-path contract) and folds keys to uint32; the consumer ships each
-batch host->device and streams it through the jitted SketchBundle update
-(count-min + HLL + entropy + top-k) with async dispatch, so host generation
-and device compute overlap through a depth-4 double buffer. Every event
-counted was generated, folded, transferred, and sketched during the timed
-window. Steady-state, first-compile excluded.
+runs the C++ synthetic source's FOLDED exporter (zipf exec tuples,
+FNV-hashed keys xor-folded to uint32 in native code — the
+ig_source_pop_folded contract) straight into pinned staging blocks from a
+PinnedBufferPool; the consumer stages each block through the depth-4
+H2DStager (the transfer of batch k+1 overlaps device compute of batch k)
+and runs the FUSED SketchBundle update (count-min + HLL + entropy + top-k
+in one device step — the Pallas fused kernel on TPU, the reference ops
+elsewhere). Every event counted was generated, staged, transferred, and
+sketched during the timed window. Steady-state, first-compile excluded.
 
 Secondary metrics ride the same JSON line under "extra":
   host_plane_ev_per_s    generator+fold throughput alone (no JAX at all) —
@@ -70,7 +72,11 @@ CPU_CHILD_TIMEOUT_S = int(os.environ.get("IG_BENCH_CPU_TIMEOUT", "240"))
 
 def _make_gen(batch: int):
     """Host-side folded-key generator: C++ synthetic source if the .so is
-    built, numpy fallback otherwise. No JAX involved either way."""
+    built, numpy fallback otherwise. No JAX involved either way. Returns
+    (gen, gen_into, impl): gen() allocates, gen_into(out) fills a caller
+    buffer (a pinned staging lane) in place — the zero-copy pipeline
+    path; impl ("C++ SoA" | "py-fold") lands in extra.pipeline so the
+    record says which host plane actually ran."""
     try:
         from inspektor_gadget_tpu.sources.bridge import (
             NativeCapture, native_available, SRC_SYNTH_EXEC,
@@ -78,7 +84,9 @@ def _make_gen(batch: int):
         if native_available():
             src = NativeCapture(SRC_SYNTH_EXEC, seed=42, vocab=5000,
                                 zipf_s=1.2)
-            return lambda: src.generate_folded(batch)
+            return (lambda: src.generate_folded(batch),
+                    lambda out: src.generate_folded(batch, out=out),
+                    "C++ SoA")
     except Exception:
         pass
     from inspektor_gadget_tpu.sources.synthetic import PySyntheticSource
@@ -89,20 +97,27 @@ def _make_gen(batch: int):
         return ((k >> np.uint64(32)) ^ (k & np.uint64(0xFFFFFFFF))).astype(
             np.uint32)
 
-    return gen
+    def gen_into(out: np.ndarray) -> None:
+        out[:] = gen()
+
+    return gen, gen_into, "py-fold"
 
 
 def host_plane_ev_per_s(batch: int = 1 << 17, seconds: float = 1.0) -> float:
-    """Generator+fold throughput with no JAX: the capture-path ceiling."""
+    """Folded-exporter throughput with no JAX (pop_folded into a pinned
+    pool block): the capture-path ceiling."""
+    from inspektor_gadget_tpu.sources.staging import PinnedBufferPool
     from inspektor_gadget_tpu.telemetry import counter
     events = counter("ig_bench_host_events_total",
                      "events generated+folded by the host plane")
-    gen = _make_gen(batch)
-    gen()  # warm (vocab tables, allocator)
+    _gen, gen_into, _impl = _make_gen(batch)
+    pool = PinnedBufferPool(batch, lanes=1, max_free=2)
+    block = pool.get()
+    gen_into(block[0])  # warm (vocab tables, allocator)
     n = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < seconds:
-        gen()
+        gen_into(block[0])
         n += batch
         events.inc(batch)
     return n / (time.perf_counter() - t0)
@@ -121,16 +136,21 @@ def run_child(platform: str) -> dict:
 
     from inspektor_gadget_tpu import telemetry as T
     from inspektor_gadget_tpu.ops import bundle_merge
-    from inspektor_gadget_tpu.ops.sketches import bundle_init, bundle_update_jit
+    from inspektor_gadget_tpu.ops.sketches import (
+        bundle_ingest_jit, bundle_init,
+    )
+    from inspektor_gadget_tpu.sources.staging import (
+        H2DStager, PinnedBufferPool,
+    )
 
     m_steps = T.counter("ig_bench_e2e_steps_total",
-                        "bundle_update steps in the timed e2e window")
+                        "fused_update steps in the timed e2e window")
     m_events = T.counter("ig_bench_e2e_events_total",
                          "events through the timed e2e window")
 
     cfg = SHAPES[platform]
     batch = cfg["batch"]
-    gen = _make_gen(batch)
+    gen, gen_into, gen_impl = _make_gen(batch)
 
     # touching the backend happens here, inside the timeout guard; report
     # the backend we actually got, not the one we asked for
@@ -142,15 +162,30 @@ def run_child(platform: str) -> dict:
                            entropy_log2_width=cfg["entropy_log2_width"],
                            k=cfg["k"])
 
+    # the shared staged-ingest step (update + fence token — the
+    # donation/fence contract is documented once, on
+    # ops.sketches.bundle_ingest_step)
+    def fused_step(b, k, w):
+        return bundle_ingest_jit(b, k, k, k, w)
+
     bundle = new_bundle()
-    mask = jnp.ones(batch, dtype=bool)
+    mask = jnp.ones(batch, dtype=jnp.int32)  # weights lane: every slot 1
+    host_pool = PinnedBufferPool(batch, lanes=1, max_free=8)
+    stager = H2DStager(host_pool, depth=4)
 
     for _ in range(3):  # compile + device warmup
-        k = jnp.asarray(gen())
-        bundle = bundle_update_jit(bundle, k, k, k, mask)
+        blk = host_pool.get()
+        gen_into(blk[0])
+        (k,) = stager.stage(blk, (blk[0],))
+        bundle, tok = fused_step(bundle, k, mask)
+        stager.fence(tok)
     jax.block_until_ready(bundle.events)
+    stager.drain()
 
     # ---- headline: end-to-end pipelined ingest ----------------------------
+    # producer fills pinned pool blocks with the native folded exporter;
+    # the consumer stages them through the depth-4 H2D ring so transfers
+    # overlap device compute of the previous batch
     import queue
     import threading
     q: queue.Queue = queue.Queue(maxsize=4)
@@ -158,10 +193,11 @@ def run_child(platform: str) -> dict:
 
     def producer() -> None:
         while not stop.is_set():
-            k = gen()
+            blk = host_pool.get()
+            gen_into(blk[0])
             while not stop.is_set():
                 try:
-                    q.put(k, timeout=0.05)
+                    q.put(blk, timeout=0.05)
                     break
                 except queue.Full:
                     continue
@@ -177,8 +213,10 @@ def run_child(platform: str) -> dict:
     t0 = time.perf_counter()
     deadline = t0 + cfg["bench_seconds"]
     while time.perf_counter() < deadline:
-        k = jnp.asarray(q.get())
-        bundle = bundle_update_jit(bundle, k, k, k, mask)
+        blk = q.get()
+        (k,) = stager.stage(blk, (blk[0],))
+        bundle, tok = fused_step(bundle, k, mask)
+        stager.fence(tok)
         steps += 1
         m_steps.inc()
         m_events.inc(batch)
@@ -192,20 +230,26 @@ def run_child(platform: str) -> dict:
     except queue.Empty:
         pass
     prod.join(timeout=2.0)
+    stager.drain()
     e2e_ev_per_s = steps * batch / dt
 
     # ---- secondary: device-plane-only (pre-staged arrays) -----------------
-    pool = [jnp.asarray(gen()) for _ in range(8)]
+    scratch = np.empty(batch, dtype=np.uint32)
+
+    def staged() -> "jnp.ndarray":
+        gen_into(scratch)
+        return jnp.asarray(np.array(scratch))  # private copy per entry
+
+    pool = [staged() for _ in range(8)]
     dbundle = new_bundle()
     for i in range(3):
-        dbundle = bundle_update_jit(dbundle, pool[i % 8], pool[i % 8],
-                                    pool[i % 8], mask)
+        dbundle, _ = fused_step(dbundle, pool[i % 8], mask)
     jax.block_until_ready(dbundle.events)
     dsteps = 0
     t0 = time.perf_counter()
     while True:
         k = pool[dsteps % 8]
-        dbundle = bundle_update_jit(dbundle, k, k, k, mask)
+        dbundle, _ = fused_step(dbundle, k, mask)
         dsteps += 1
         if dsteps % 8 == 0:
             jax.block_until_ready(dbundle.events)
@@ -232,6 +276,7 @@ def run_child(platform: str) -> dict:
         "merge_ms_p50": round(float(np.percentile(times, 50) * 1000), 3),
         "platform": actual,
         "batch": batch,
+        "gen_impl": gen_impl,
         # the child's live pipeline counters ride home with its result so
         # the parent's record carries them (the registry is per-process)
         "telemetry": T.snapshot(),
@@ -287,8 +332,12 @@ def _probe_with_retry() -> tuple[dict | None, str, list[dict]]:
 
 
 def main(forced: str | None = None, ledger: str | None = None) -> None:
+    # the impl placeholder is replaced with what the CHILD actually ran
+    # (C++ SoA exporter or the py-fold fallback) once its result is in —
+    # a py-fold record must never claim the native host plane
     extra: dict = {"pipeline":
-                   "gen(C++)->fold32->H2D->bundle_update, depth-4 queue"}
+                   "pop_folded(?)->pinned-pool->h2d_overlap(depth4)"
+                   "->fused_update"}
     try:
         extra["host_plane_ev_per_s"] = round(host_plane_ev_per_s(), 1)
     except Exception as e:  # noqa: BLE001
@@ -329,6 +378,8 @@ def main(forced: str | None = None, ledger: str | None = None) -> None:
         extra["device_plane_ev_per_s"] = result["device_plane_ev_per_s"]
         extra["merge_ms_p50"] = result["merge_ms_p50"]
         extra["batch"] = result["batch"]
+        extra["pipeline"] = extra["pipeline"].replace(
+            "(?)", f"({result.get('gen_impl', 'unknown')})")
     else:
         # every backend failed: value 0 under the e2e metric name (the host
         # plane alone is NOT e2e throughput — it stays in extra where it is
@@ -336,6 +387,7 @@ def main(forced: str | None = None, ledger: str | None = None) -> None:
         value = 0.0
         extra["platform"] = "none"
         extra["degraded"] = True
+        extra["pipeline"] = extra["pipeline"].replace("(?)", "(none)")
     if errors:
         extra["error"] = errors
     if probe_trail:
@@ -385,10 +437,13 @@ def _append_ledger(record: dict, probe_trail: list[dict], errors: dict,
     from inspektor_gadget_tpu.perf.provenance import build_provenance
     extra = record["extra"]
     stages: dict = {}
+    # fused-pipeline stage names (ISSUE 10): the host plane IS the folded
+    # exporter and the device plane the fused update; the config stays
+    # "bench.e2e" so compare never forks the series vs old records
     if isinstance(extra.get("host_plane_ev_per_s"), (int, float)):
-        stages["pop"] = {"ev_per_s": extra["host_plane_ev_per_s"]}
+        stages["pop_folded"] = {"ev_per_s": extra["host_plane_ev_per_s"]}
     if isinstance(extra.get("device_plane_ev_per_s"), (int, float)):
-        stages["bundle_update"] = {"ev_per_s": extra["device_plane_ev_per_s"]}
+        stages["fused_update"] = {"ev_per_s": extra["device_plane_ev_per_s"]}
     if isinstance(extra.get("merge_ms_p50"), (int, float)):
         stages["merge"] = {"ms_p50": extra["merge_ms_p50"]}
     outcome = "ok" if not extra["degraded"] else "degraded"

@@ -50,6 +50,13 @@ def add_bench_parser(sub) -> None:
                     help="feed the harness a capture journal instead of "
                          "the synthetic source (reproducible input; the "
                          "journal digest lands in the record provenance)")
+    rp.add_argument("--pipeline", default="fused",
+                    choices=["fused", "classic"],
+                    help="hot-path shape: fused (pop_folded->h2d_overlap->"
+                         "fused_update, default) or classic (pop->decode->"
+                         "enrich->fold32->h2d->bundle_update); both append "
+                         "to the same ledger series, extra.pipeline says "
+                         "which ran")
     rp.add_argument("--no-ledger", action="store_true",
                     help="print the record without appending it")
     rp.add_argument("-o", "--output", default="json",
@@ -97,7 +104,8 @@ def cmd_bench_run(args) -> int:
             probe_attempts=args.probe_attempts,
             probe_horizon=args.probe_horizon,
             trace_out=args.trace_out or None,
-            replay=args.replay or None)
+            replay=args.replay or None,
+            pipeline=args.pipeline)
     except (ValueError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

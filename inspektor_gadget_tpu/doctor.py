@@ -55,6 +55,33 @@ def _probe_native_lib() -> Window:
         return Window("native_lib", False, repr(e))
 
 
+def _probe_native_toolchain() -> Window:
+    """Build-plane row (ISSUE 10 satellite): can this host COMPILE the
+    native capture library from source? The tier-1 native-build smoke
+    test (tests/test_native_build.py) keys off the same facts — a missing
+    toolchain skips the build tier there and degrades this row here, so
+    the skip is visible in the doctor instead of silent."""
+    try:
+        import shutil
+        from pathlib import Path
+        cxx = os.environ.get("CXX") or "g++"
+        have_cxx = shutil.which(cxx)
+        have_make = shutil.which("make")
+        so = (Path(__file__).resolve().parent / "native"
+              / "libigcapture.so")
+        built = "lib built" if so.exists() else "lib not built yet"
+        if have_cxx and have_make:
+            return Window("native_toolchain", True,
+                          f"{cxx}+make present ({built})")
+        missing = " ".join(n for n, ok in ((cxx, have_cxx),
+                                           ("make", have_make)) if not ok)
+        return Window("native_toolchain", False,
+                      f"missing {missing} — native-build smoke tier "
+                      f"skips; a prebuilt .so still loads ({built})")
+    except Exception as e:  # noqa: BLE001
+        return Window("native_toolchain", False, repr(e))
+
+
 def _probe_fanotify() -> Window:
     try:
         from .sources.bridge import _load
@@ -345,7 +372,8 @@ def _probe_procfs() -> Window:
 
 
 _PROBES = (
-    _probe_native_lib, _probe_fanotify, _probe_perf, _probe_kmsg,
+    _probe_native_lib, _probe_native_toolchain, _probe_fanotify,
+    _probe_perf, _probe_kmsg,
     _probe_ptrace, _probe_sock_diag, _probe_netlink_proc, _probe_af_packet,
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
     _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,

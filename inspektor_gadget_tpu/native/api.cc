@@ -375,6 +375,36 @@ int64_t ig_source_pop_batch(uint64_t h, int64_t n, uint64_t* ts,
   return (int64_t)got;
 }
 
+// Folded SoA batch exporter — the zero-copy sketch-ingest hot path.
+//
+// The classic pop (ig_source_pop_batch) hands Python nine 64/32-bit
+// columns which the sketch plane then folds to uint32 and re-copies into
+// a staging buffer: at 100M+ ev/s the fold + copy + per-column ctypes
+// bookkeeping IS the pipeline wall (BENCH_r04: host plane ~130M vs
+// device plane 2.6B ev/s). This call drains the ring straight into the
+// caller's pre-folded uint32 lanes — keys (xor-folded key_hash, the
+// sketch key width), weights (per-event weight, 1 today; the lane exists
+// so a capture shim may pre-aggregate runs of equal keys), and mntns
+// (xor-folded, exact for real mount-ns inode numbers < 2^32) — so Python
+// does ZERO per-event work and the lanes land directly in the pinned H2D
+// staging buffer. weights/mntns may be null to skip those lanes.
+int64_t ig_source_pop_folded(uint64_t h, int64_t n, uint32_t* keys,
+                             uint32_t* weights, uint32_t* mntns) {
+  Source* s = lookup(h);
+  if (!s || n <= 0 || !keys) return -1;
+  static thread_local std::vector<Event> tmp;
+  tmp.resize((size_t)n);
+  size_t got = s->pop(tmp.data(), (size_t)n);
+  for (size_t i = 0; i < got; i++) {
+    const Event& e = tmp[i];
+    keys[i] = (uint32_t)((e.key_hash >> 32) ^ (e.key_hash & 0xFFFFFFFFull));
+    if (weights) weights[i] = 1u;
+    if (mntns)
+      mntns[i] = (uint32_t)((e.mntns >> 32) ^ (e.mntns & 0xFFFFFFFFull));
+  }
+  return (int64_t)got;
+}
+
 uint64_t ig_source_drops(uint64_t h) {
   Source* s = lookup(h);
   return s ? s->drops() : 0;

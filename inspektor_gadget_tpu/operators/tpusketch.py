@@ -34,10 +34,11 @@ from ..gadgets.interface import GadgetDesc
 from ..models.autoencoder import AEConfig, ae_init, ae_score, ae_train_step, normalize_counts
 from ..ops import bundle_init, fold64_to_32
 from ..ops.hll import hll_init, hll_update
-from ..ops.sketches import bundle_digest_jit, bundle_update_jit, decode_digest
+from ..ops.sketches import bundle_digest_jit, bundle_ingest_jit, decode_digest
 from ..ops.window import wcms_advance, wcms_init, wcms_query, wcms_update
 from ..params import ParamDesc, ParamDescs, Params, TypeHint
-from ..sources.batch import EventBatch
+from ..sources.batch import EventBatch, FoldedBatch
+from ..sources.staging import H2DStager, PinnedBufferPool
 from ..telemetry import counter, histogram
 from ..telemetry.tracing import TRACER, device_annotation
 from ..utils.logger import get_logger
@@ -75,9 +76,31 @@ _ckpt_log = get_logger("ig-tpu.tpusketch")
 # fresh HLL per window tracks its distinct stream; entropy and
 # events/drops come as deltas of the cumulative bundle (additive state
 # is exactly subtractable, HLL is not)
-_wcms_update_jit = jax.jit(wcms_update, donate_argnums=0)
 _wcms_advance_jit = jax.jit(wcms_advance, donate_argnums=0)
-_hll_update_jit = jax.jit(hll_update, donate_argnums=0)
+
+
+# The fused ingest step (ISSUE 10 tentpole) is the SHARED
+# ops.sketches.bundle_ingest_jit: staged uint32 weights pass through as
+# integer per-event weights (pad slots 0; pre-aggregated runs may weigh
+# > 1), the fused-vs-reference selection happens inside
+# bundle_update_fused at trace time, and the second output is the fence
+# token the stager blocks on (the donation/fence contract is documented
+# ONCE, on bundle_ingest_step).
+_ingest_jit = bundle_ingest_jit
+
+
+def _wcms_ingest_step(w, keys, weights):
+    out = wcms_update(w, keys, weights)
+    return out, out.slots[0, 0, :1] + 0
+
+
+def _hll_ingest_step(h, keys, mask):
+    out = hll_update(h, keys, mask)
+    return out, out.registers[:1] + 0
+
+
+_wcms_ingest_jit = jax.jit(_wcms_ingest_step, donate_argnums=0)
+_hll_ingest_jit = jax.jit(_hll_ingest_step, donate_argnums=0)
 
 
 @dataclasses.dataclass
@@ -190,6 +213,10 @@ class TpuSketch(Operator):
                                   "sequence scorer"),
             ParamDesc(key="harvest-interval", default="1s",
                       type_hint=TypeHint.DURATION),
+            ParamDesc(key="h2d-depth", default="2", type_hint=TypeHint.INT,
+                      description="H2D double-buffer depth: transfers of "
+                                  "batch k+1..k+N-1 overlap device compute "
+                                  "of batch k"),
             # sketch-history plane: seal one mergeable window per
             # boundary into the node's sealed-window store (history/)
             ParamDesc(key="history", default="false", type_hint=TypeHint.BOOL,
@@ -296,6 +323,20 @@ class TpuSketchInstance(OperatorInstance):
             if bs > 0:
                 pad = max(pad, 1 << (bs - 1).bit_length())
         self._pad = pad
+        # pinned staging pool + depth-N H2D double buffer (created lazily
+        # at the first batch, once the pad shape is known for real)
+        self._h2d_depth = (p.get("h2d-depth").as_int()
+                           if "h2d-depth" in p else 2)
+        self._pool: PinnedBufferPool | None = None
+        self._stager: H2DStager | None = None
+        # late-enrichment sample ring (display-only work moved OFF the
+        # ingest path): per batch two vectorized slice writes capture a
+        # few (k64, k32, comm) rows; names resolve lazily at harvest/seal
+        self._lbl_cap = 1024
+        self._lbl_k64 = np.zeros(self._lbl_cap, np.uint64)
+        self._lbl_k32 = np.zeros(self._lbl_cap, np.uint32)
+        self._lbl_comm = np.zeros((self._lbl_cap, 8), np.uint8)
+        self._lbl_i = 0
         # self-observability feed for top/sketch (top/ebpf analogue)
         from ..gadgets.top.sketch import SketchStatsSource
         self._stats = SketchStatsSource(ctx.run_id, ctx.desc.full_name)
@@ -364,6 +405,22 @@ class TpuSketchInstance(OperatorInstance):
 
     # the columnar hot path -------------------------------------------------
 
+    def _staging_for(self, pad: int) -> tuple[PinnedBufferPool, H2DStager]:
+        """The pinned pool + stager for the current pad shape; a pad
+        growth (rare: one bigger batch) drains the old stager first so
+        no in-flight block leaks the occupancy gauge. self._pad is
+        ratcheted to the new shape so later normal-sized batches keep
+        the grown pool instead of rebuilding it every flip."""
+        if self._pool is None or self._pool.capacity != pad:
+            if self._stager is not None:
+                self._stager.drain()
+            # 4 lanes: up to three distinct key columns + the weights lane
+            self._pool = PinnedBufferPool(pad, lanes=4,
+                                          max_free=self._h2d_depth + 2)
+            self._stager = H2DStager(self._pool, depth=self._h2d_depth)
+        self._pad = max(self._pad, pad)
+        return self._pool, self._stager
+
     def enrich_batch(self, batch: EventBatch) -> None:
         if not self.enabled or batch.count == 0:
             return
@@ -372,44 +429,68 @@ class TpuSketchInstance(OperatorInstance):
         while pad < n:
             pad *= 2
 
-        def keys_for(colname: str) -> np.ndarray:
-            a = batch.cols[colname][:n]
-            if a.dtype == np.uint64:
-                k = fold64_to_32(a)
-            else:
-                k = a.astype(np.uint32)
-            out = np.zeros(pad, dtype=np.uint32)
-            out[:n] = k
-            return out
-
         t0 = time.perf_counter()
         with self._span("tpusketch/h2d", events=n, pad=pad):
+            pool, stager = self._staging_for(pad)
+            block = pool.get()
+            lanes: dict[str, np.ndarray] = {}
+
+            def keys_for(colname: str) -> np.ndarray:
+                lane = lanes.get(colname)
+                if lane is None:
+                    lane = block[len(lanes)]
+                    a = batch.cols[colname][:n]
+                    if a.dtype == np.uint64:
+                        lane[:n] = fold64_to_32(a)
+                    else:
+                        lane[:n] = a
+                    lane[n:] = 0
+                    lanes[colname] = lane
+                return lane
+
             hh = keys_for(self.hh_col)
-            distinct = hh if self.distinct_col == self.hh_col else keys_for(self.distinct_col)
-            dist = hh if self.dist_col == self.hh_col else keys_for(self.dist_col)
-            mask = np.zeros(pad, dtype=bool)
-            mask[:n] = True
+            distinct = keys_for(self.distinct_col)
+            dist = keys_for(self.dist_col)
+            w = block[3]
+            w[:n] = 1
+            w[n:] = 0
             new_drops = batch.drops - self._drops_seen
             self._drops_seen = batch.drops
-            hh_d, distinct_d, dist_d, mask_d = (
-                jnp.asarray(hh), jnp.asarray(distinct), jnp.asarray(dist),
-                jnp.asarray(mask))
+            # ONE async device put per distinct lane (shared columns stage
+            # once); the transfer of this batch overlaps device compute of
+            # the previous one — the block returns to the pool only after
+            # the consumer fence below completes
+            uniq = list(lanes.values())
+            staged = stager.stage(block, uniq + [w])
+            by_col = dict(zip(lanes.keys(), staged[:-1]))
+            hh_d = by_col[self.hh_col]
+            distinct_d = by_col[self.distinct_col]
+            dist_d = by_col[self.dist_col]
+            w_d = staged[-1]
         t1 = time.perf_counter()
         with self._span("tpusketch/update", events=n), \
                 device_annotation("ig:tpusketch_update"):
             with self._bundle_mu:
-                self.bundle = bundle_update_jit(
-                    self.bundle, hh_d, distinct_d, dist_d, mask_d,
+                self.bundle, tok = _ingest_jit(
+                    self.bundle, hh_d, distinct_d, dist_d, w_d,
                     jnp.float32(max(new_drops, 0)),
                 )
+        fence = [tok]
         if self._hist_on:
             # window-plane device steps ride the same staged arrays: the
             # WindowedCMS current slot and the per-window HLL absorb the
             # batch so a seal reads window-only state
-            w32 = mask_d.astype(jnp.int32)
-            self._wcms = _wcms_update_jit(self._wcms, hh_d, w32)
-            self._win_hll = _hll_update_jit(self._win_hll, distinct_d, mask_d)
+            self._wcms, wtok = _wcms_ingest_jit(self._wcms, hh_d,
+                                                w_d.astype(jnp.int32))
+            self._win_hll, htok = _hll_ingest_jit(self._win_hll, distinct_d,
+                                                  w_d > 0)
             self._accumulate_slices(batch, n, hh, distinct, dist)
+            fence += [wtok, htok]
+        # every consumer of the staged arrays is in the fence: the pinned
+        # block is reused only once they all completed (on CPU PJRT the
+        # device arrays may alias the host block, so transfer-complete
+        # alone is not enough)
+        stager.fence(tuple(fence))
         t2 = time.perf_counter()
         self._m_h2d.observe(t1 - t0)
         self._m_update.observe(t2 - t1)
@@ -420,18 +501,10 @@ class TpuSketchInstance(OperatorInstance):
         self._stats.steps += 1
         self._stats.events += n
         self._stats.drops = batch.drops
-        # label sampling: heavy keys recur in nearly every batch, so a small
-        # per-batch sample builds the key32 → name table without touching
-        # the hot path measurably
-        raw = batch.cols[self.hh_col]
-        resolve = getattr(self.gadget, "resolve_key", None)
-        for i in range(min(n, 32)):
-            k32 = int(hh[i])
-            if k32 and k32 not in self._names:
-                name = ""
-                if resolve is not None and raw.dtype == np.uint64:
-                    name = resolve(int(raw[i]))
-                self._names[k32] = name or batch.comm_str(i) or f"0x{k32:08x}"
+        # late enrichment (display-only work off the ingest path): two
+        # vectorized slice writes park a small (k64, k32, comm) sample in
+        # the rolling ring; name resolution happens at harvest/seal time
+        self._label_sample(batch, hh, n)
         if self.anomaly_on:
             self._accumulate_container_dists(batch, n)
         if self._hist_on and self._hist_interval > 0 and \
@@ -441,6 +514,132 @@ class TpuSketchInstance(OperatorInstance):
         if now - self._last_harvest >= self.harvest_interval:
             self._last_harvest = now
             self.harvest()
+
+    def ingest_folded(self, fb: FoldedBatch) -> None:
+        """Zero-copy ingest of a pre-folded SoA batch (ig_source_pop_folded
+        → PinnedBufferPool block): no EventBatch, no decode, no fold pass.
+        The block must come from folded_block() — the stager returns it to
+        this instance's pool once the update fence completes. The single
+        keys lane feeds all three sketch streams (the folded fast path is
+        for single-key-column gadgets; column-split gadgets take
+        enrich_batch). The history window plane rides the same staged
+        arrays, so sealed windows stay correct — but they carry NO
+        subpopulation slices (the wire's kind column does not exist on
+        the folded path) and no anomaly distributions; gadgets that need
+        either must ingest through enrich_batch."""
+        if not self.enabled or fb.count == 0:
+            return
+        n = fb.count
+        t0 = time.perf_counter()
+        with self._span("tpusketch/h2d", events=n, pad=fb.capacity):
+            _pool, stager = self._staging_for(fb.capacity)
+            if n < fb.capacity:
+                fb.keys[n:] = 0
+                fb.weights[n:] = 0
+            new_drops = fb.drops - self._drops_seen
+            self._drops_seen = fb.drops
+            k_d, w_d = stager.stage(fb.lanes, (fb.keys, fb.weights))
+        t1 = time.perf_counter()
+        with self._span("tpusketch/update", events=n), \
+                device_annotation("ig:tpusketch_update"):
+            with self._bundle_mu:
+                self.bundle, tok = _ingest_jit(
+                    self.bundle, k_d, k_d, k_d, w_d,
+                    jnp.float32(max(new_drops, 0)))
+        fence = [tok]
+        if self._hist_on:
+            # same window-plane steps as enrich_batch: the WindowedCMS
+            # current slot and per-window HLL absorb the staged batch so
+            # interval seals read correct window-only state (minus
+            # slices — see the docstring)
+            self._wcms, wtok = _wcms_ingest_jit(self._wcms, k_d,
+                                                w_d.astype(jnp.int32))
+            self._win_hll, htok = _hll_ingest_jit(self._win_hll, k_d,
+                                                  w_d > 0)
+            fence += [wtok, htok]
+        stager.fence(tuple(fence))
+        t2 = time.perf_counter()
+        self._m_h2d.observe(t1 - t0)
+        self._m_update.observe(t2 - t1)
+        self._m_events.inc(n)
+        self._m_steps.inc()
+        if new_drops > 0:
+            self._m_drops.inc(new_drops)
+        self._stats.steps += 1
+        self._stats.events += n
+        self._stats.drops = fb.drops
+        if self._hist_on and self._hist_interval > 0 and \
+                self._hist_clock() - self._win_start >= self._hist_interval:
+            self.seal_window()
+        now = time.monotonic()
+        if now - self._last_harvest >= self.harvest_interval:
+            self._last_harvest = now
+            self.harvest()
+
+    def folded_block(self) -> np.ndarray:
+        """A pinned (4, pad) staging block for pop_folded (rows 0..2 are
+        the keys/weights/mntns lanes; row 3 is unused padding)."""
+        pool, _ = self._staging_for(self._pad)
+        return pool.get()
+
+    # -- late enrichment (off the ingest path) ------------------------------
+
+    def _label_sample(self, batch: EventBatch, hh: np.ndarray,
+                      n: int) -> None:
+        """Park up to 64 (k64, k32, comm) rows per batch in the rolling
+        ring — pure slice writes, no per-row Python."""
+        s = min(n, 64)
+        raw = batch.cols[self.hh_col][:s]
+        # only real 64-bit key hashes can be un-hashed through the vocab;
+        # a widened uint32 column value would cost a guaranteed-miss
+        # native lookup per key (and could alias a real vocab key), so
+        # non-u64 columns park 0 and resolve falls through to comm
+        is_hash = raw.dtype == np.uint64
+        cap = self._lbl_cap
+        i = self._lbl_i
+        first = min(s, cap - i)
+        self._lbl_k32[i:i + first] = hh[:first]
+        self._lbl_k64[i:i + first] = raw[:first] if is_hash else 0
+        if batch.comm is not None:
+            self._lbl_comm[i:i + first] = batch.comm[:first]
+        else:
+            self._lbl_comm[i:i + first] = 0
+        rem = s - first
+        if rem:
+            self._lbl_k32[:rem] = hh[first:s]
+            self._lbl_k64[:rem] = raw[first:s] if is_hash else 0
+            if batch.comm is not None:
+                self._lbl_comm[:rem] = batch.comm[first:s]
+            else:
+                self._lbl_comm[:rem] = 0
+        self._lbl_i = (i + s) % cap
+
+    def _resolve_late(self, keys32) -> None:
+        """Resolve display names for (few) heavy-hitter keys from the
+        sample ring — runs once per harvest/seal tick, never per batch.
+        A key ABSENT from the ring is left unresolved (not cached as
+        hex): it may age back into the ring on a later batch, and a
+        cached placeholder would block resolution forever. A key found
+        in the ring but yielding no vocab/comm name caches the hex
+        fallback — that row really carried no name, matching the old
+        per-batch behavior."""
+        resolve = getattr(self.gadget, "resolve_key", None)
+        for k in keys32:
+            k = int(k)
+            if not k or k in self._names:
+                continue
+            j = np.flatnonzero(self._lbl_k32 == np.uint32(k))
+            if not j.size:
+                continue  # not sampled yet — retry next tick
+            jj = int(j[0])
+            k64 = int(self._lbl_k64[jj])
+            name = ""
+            if resolve is not None and k64:
+                name = resolve(k64) or ""
+            if not name:
+                comm = bytes(self._lbl_comm[jj])
+                name = comm.split(b"\0", 1)[0].decode("utf-8", "replace")
+            self._names[k] = name or f"0x{k:08x}"
 
     def _accumulate_container_dists(self, batch: EventBatch, n: int) -> None:
         mntns = batch.cols["mntns"][:n]
@@ -547,6 +746,7 @@ class TpuSketchInstance(OperatorInstance):
         order = np.argsort(-counts)
         keep = [(int(cand[i]), int(counts[i])) for i in order
                 if cand[i] != 0 and counts[i] > 0]
+        self._resolve_late([k for k, _ in keep[:32]])
         self._win_n += 1
         win = SealedWindow(
             gadget=self._hist_gadget,
@@ -611,6 +811,9 @@ class TpuSketchInstance(OperatorInstance):
             decode_digest(digest))
         order = np.argsort(-counts)
         hh = [(int(keys[i]), int(counts[i])) for i in order if keys[i] != 0]
+        # late enrichment: names resolve HERE (once per tick, from the
+        # sample ring), not in the per-batch ingest path
+        self._resolve_late([k for k, _ in hh[:32]])
         anomaly = None
         if self.anomaly_on and self.anomaly_model == "seq":
             anomaly = self._seq_score_containers()
@@ -668,6 +871,10 @@ class TpuSketchInstance(OperatorInstance):
                 self.seal_window()
                 from ..history import HISTORY
                 HISTORY.release(self._hist_writer)
+            if self._stager is not None:
+                # release every in-flight staging block (and zero the
+                # occupancy gauge) before the instance goes away
+                self._stager.drain()
             self._stats.unregister()
             if _ckpt_dir is not None:
                 # shutdown save stays best-effort, but failures are now

@@ -21,7 +21,10 @@ from .quantiles import (
     DDSketch, dd_init, dd_update, dd_quantile, dd_merge, dd_psum,
     dd_histogram_log2,
 )
-from .sketches import SketchBundle, bundle_init, bundle_update, bundle_merge
+from .sketches import (
+    SketchBundle, bundle_init, bundle_update, bundle_update_fused,
+    bundle_merge, fused_supported,
+)
 
 __all__ = [
     "fold64_to_32", "fmix32", "multiply_shift",
@@ -31,5 +34,6 @@ __all__ = [
     "TopK", "topk_init", "topk_update", "topk_merge", "topk_values",
     "DDSketch", "dd_init", "dd_update", "dd_quantile", "dd_merge",
     "dd_psum", "dd_histogram_log2",
-    "SketchBundle", "bundle_init", "bundle_update", "bundle_merge",
+    "SketchBundle", "bundle_init", "bundle_update", "bundle_update_fused",
+    "bundle_merge", "fused_supported",
 ]

@@ -93,3 +93,129 @@ def xla_histogram(keys: jnp.ndarray, weights: jnp.ndarray, *,
     idx = (h >> (32 - log2_width)).astype(jnp.int32)
     return jnp.zeros(1 << log2_width, jnp.float32).at[idx].add(
         weights.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Fused bundle_update kernel (ISSUE 10 tentpole).
+#
+# SketchLib / NitroSketch observation: the order-of-magnitude win is ONE
+# pass over the staged batch updating every sketch plane, instead of one
+# dispatched op per sketch. This kernel folds the three histogram-shaped
+# planes (depth count-min rows + the entropy buckets) and the HLL
+# register-max plane into a single pallas_call:
+#
+#   grid = (n_planes, Wmax/W_TILE), n_planes = depth + 2
+#   plane 0..depth-1   CMS row d:  h = fmix32(hh * mult_d + salt_d)
+#   plane depth        entropy:    h = fmix32(dist * mult_0)
+#   plane depth+1      HLL:        h = fmix32(distinct); value = rank,
+#                                  combined by MAX instead of ADD
+#
+# Every plane is padded to the widest plane's tile count so the grid and
+# index maps stay trivial; tiles past a narrow plane's real width can
+# never match a bucket index and write zero blocks that the host-side
+# wrapper slices off (bounded wasted VPU work, shape-generic kernel).
+# Accumulation is f32 — exact for per-batch bucket deltas < 2^24 (the
+# staged batch is <= 2^17 rows), so casting the deltas back to the
+# sketches' int32 state is bit-identical to the reference scatter path;
+# the parity tier in tests/test_sketches.py holds both to that contract.
+# ---------------------------------------------------------------------------
+
+
+def _fused_kernel(hh_ref, distinct_ref, dist_ref, w_ref, out_ref, *,
+                  depth: int, log2_width: int, ent_log2_width: int,
+                  hll_p: int, n_chunks: int):
+    plane = pl.program_id(0)
+    tile = pl.program_id(1)
+
+    # per-plane hash parameters, selected by the traced plane id through
+    # scalar where-chains (immediates — a pallas kernel cannot capture
+    # host-built constant arrays); the multipliers mirror
+    # ops.hashing._row_multiplier's seed table so the fused state merges
+    # coherently with every other process
+    from .hashing import _row_multiplier
+
+    def sel(vals):
+        out = jnp.uint32(vals[-1])
+        for i in range(len(vals) - 2, -1, -1):
+            out = jnp.where(plane == i, jnp.uint32(vals[i]), out)
+        return out
+
+    mult = sel([int(_row_multiplier(d)) for d in range(depth)]
+               + [int(_row_multiplier(0)), 1])
+    salt = sel([(d * 0x9E3779B9) & 0xFFFFFFFF for d in range(depth)]
+               + [0, 0])
+    shift = sel([32 - log2_width] * depth
+                + [32 - ent_log2_width, 32 - hll_p])
+    iota = jax.lax.broadcasted_iota(jnp.int32, (N_CHUNK, W_TILE), 1)
+
+    def hist_body(c, acc):
+        keys = jnp.where(plane < depth, hh_ref[c, :], dist_ref[c, :])
+        wk = w_ref[c, :]
+        h = _fmix32(keys.astype(jnp.uint32) * mult + salt)
+        idx = (h >> shift).astype(jnp.int32)
+        local = idx - tile * W_TILE
+        onehot = (local[:, None] == iota).astype(jnp.float32)
+        return acc + jnp.dot(wk[None, :], onehot,
+                             preferred_element_type=jnp.float32)
+
+    def hll_body(c, acc):
+        keys = distinct_ref[c, :]
+        wk = w_ref[c, :]
+        h = _fmix32(keys.astype(jnp.uint32))
+        idx = (h >> (32 - hll_p)).astype(jnp.int32)
+        # rank = leading zeros of the remaining (32-p) bits, +1 — the
+        # exact ops.hll.hll_update formula, masked rows contribute 0
+        rest = (h << hll_p) | jnp.uint32((1 << hll_p) - 1)
+        rank = jnp.clip(jax.lax.clz(rest.astype(jnp.int32)), 0, 32 - hll_p) + 1
+        rank = jnp.where(wk > 0, rank, 0).astype(jnp.float32)
+        local = idx - tile * W_TILE
+        contrib = jnp.where(local[:, None] == iota, rank[:, None], 0.0)
+        return jnp.maximum(acc, contrib.max(axis=0, keepdims=True))
+
+    zero = jnp.zeros((1, W_TILE), jnp.float32)
+    acc = jax.lax.cond(
+        plane == depth + 1,
+        lambda: jax.lax.fori_loop(0, n_chunks, hll_body, zero),
+        lambda: jax.lax.fori_loop(0, n_chunks, hist_body, zero))
+    out_ref[0, 0, :, :] = acc.reshape(8, 128)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "depth", "log2_width", "ent_log2_width", "hll_p", "interpret"))
+def fused_sketch_planes(hh_keys: jnp.ndarray, distinct_keys: jnp.ndarray,
+                        dist_keys: jnp.ndarray, weights: jnp.ndarray, *,
+                        depth: int, log2_width: int, ent_log2_width: int,
+                        hll_p: int, interpret: bool = False
+                        ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One fused pass over the staged batch → per-plane state deltas:
+    (cms_delta (depth, W) f32, ent_delta (2**ent_log2_width,) f32,
+    hll_batch_ranks (2**hll_p,) f32). n must be a multiple of N_CHUNK and
+    the WIDEST plane a multiple of W_TILE (pad the sketch config, not the
+    data). `interpret=True` runs the kernel in the Pallas interpreter —
+    how the parity tier exercises the kernel math on CPU CI."""
+    n = hh_keys.shape[0]
+    wmax = max(1 << log2_width, 1 << ent_log2_width, 1 << hll_p)
+    assert n % N_CHUNK == 0 and wmax % W_TILE == 0
+    n_chunks = n // N_CHUNK
+    n_planes = depth + 2
+    tiles = wmax // W_TILE
+    shape2 = (n_chunks, N_CHUNK)
+    w2 = weights.astype(jnp.float32).reshape(shape2)
+    kernel = functools.partial(
+        _fused_kernel, depth=depth, log2_width=log2_width,
+        ent_log2_width=ent_log2_width, hll_p=hll_p, n_chunks=n_chunks)
+    batch_spec = pl.BlockSpec(shape2, lambda p, t: (0, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_planes, tiles),
+        in_specs=[batch_spec] * 4,
+        out_specs=pl.BlockSpec((1, 1, 8, 128), lambda p, t: (p, t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_planes, tiles, 8, 128),
+                                       jnp.float32),
+        interpret=interpret,
+    )(hh_keys.reshape(shape2), distinct_keys.reshape(shape2),
+      dist_keys.reshape(shape2), w2)
+    out = out.reshape(n_planes, wmax)
+    return (out[:depth, :1 << log2_width],
+            out[depth, :1 << ent_log2_width],
+            out[depth + 1, :1 << hll_p])

@@ -14,6 +14,8 @@ Key streams per batch (all uint32, padded to fixed length with mask):
 
 from __future__ import annotations
 
+import os
+
 import flax.struct
 import jax
 import jax.numpy as jnp
@@ -90,6 +92,115 @@ def bundle_merge(a: SketchBundle, b: SketchBundle) -> SketchBundle:
 bundle_update_jit = jax.jit(bundle_update, donate_argnums=0)
 
 
+# -- fused single-pass update (ISSUE 10 tentpole) ---------------------------
+# On TPU with aligned shapes the four sketch planes update in ONE Pallas
+# pass over the staged batch (ops/pallas_kernels.fused_sketch_planes);
+# everywhere else bundle_update above stays the reference implementation
+# AND the runtime fallback — the selection mirrors entropy_update's
+# pallas_histogram/xla_histogram split. IG_FUSED_DISABLE=1 forces the
+# reference path even on TPU. The env var is read at TRACE time (inside
+# bundle_update_fused), so it takes effect for any shape not yet
+# compiled; already-cached traces keep their path until retrace.
+
+
+def fused_supported(bundle: SketchBundle, n: int) -> bool:
+    """Shape gate for the fused kernel: batch rows must tile into MXU
+    chunks and the widest plane into lane tiles (pad the config, not the
+    data); odd shapes take the reference path automatically."""
+    from .pallas_kernels import N_CHUNK, W_TILE
+    wmax = max(bundle.cms.width, bundle.entropy.counts.shape[0],
+               bundle.hll.registers.shape[0])
+    return n % N_CHUNK == 0 and wmax % W_TILE == 0
+
+
+def _bundle_update_pallas(
+    bundle: SketchBundle,
+    hh_keys: jnp.ndarray,
+    distinct_keys: jnp.ndarray,
+    dist_keys: jnp.ndarray,
+    mask: jnp.ndarray,
+    drops: jnp.ndarray | None = None,
+    *,
+    interpret: bool = False,
+) -> SketchBundle:
+    """Assemble the next bundle from the fused kernel's per-plane deltas.
+    Every expression mirrors the reference ops bit-for-bit: f32 deltas are
+    exact integers for batches < 2^24 rows, int32 casts are exact, the
+    top-k refresh is the SAME topk_update against the already-updated CMS.
+    Exposed (with interpret=True) to the parity tier; production entry is
+    bundle_update_fused below."""
+    from .pallas_kernels import fused_sketch_planes
+    w_i32 = mask.astype(jnp.int32)
+    cms_d, ent_d, ranks = fused_sketch_planes(
+        hh_keys, distinct_keys, dist_keys, w_i32,
+        depth=bundle.cms.depth, log2_width=bundle.cms.log2_width,
+        ent_log2_width=bundle.entropy.log2_width, hll_p=bundle.hll.p,
+        interpret=interpret)
+    cms = bundle.cms.replace(
+        table=bundle.cms.table + cms_d.astype(bundle.cms.table.dtype),
+        total=bundle.cms.total + w_i32.sum().astype(jnp.float32))
+    return bundle.replace(
+        cms=cms,
+        hll=bundle.hll.replace(registers=jnp.maximum(
+            bundle.hll.registers, ranks.astype(jnp.int32))),
+        entropy=bundle.entropy.replace(
+            counts=bundle.entropy.counts + ent_d),
+        topk=topk_update(bundle.topk, cms, hh_keys, mask),
+        events=bundle.events + mask.sum(dtype=jnp.float32),
+        drops=bundle.drops + (drops if drops is not None else 0.0),
+    )
+
+
+def bundle_update_fused(
+    bundle: SketchBundle,
+    hh_keys: jnp.ndarray,
+    distinct_keys: jnp.ndarray,
+    dist_keys: jnp.ndarray,
+    mask: jnp.ndarray,
+    drops: jnp.ndarray | None = None,
+) -> SketchBundle:
+    """Drop-in bundle_update replacement: fused Pallas pass on TPU with
+    aligned shapes, the reference composition everywhere else. Both paths
+    produce bit-identical state (tests/test_sketches.py parity tier)."""
+    if (os.environ.get("IG_FUSED_DISABLE", "") != "1"
+            and jax.default_backend() == "tpu"
+            and fused_supported(bundle, hh_keys.shape[0])):
+        return _bundle_update_pallas(bundle, hh_keys, distinct_keys,
+                                     dist_keys, mask, drops)
+    return bundle_update(bundle, hh_keys, distinct_keys, dist_keys, mask,
+                         drops)
+
+
+def bundle_ingest_step(
+    bundle: SketchBundle,
+    hh_keys: jnp.ndarray,
+    distinct_keys: jnp.ndarray,
+    dist_keys: jnp.ndarray,
+    weights: jnp.ndarray,
+    drops: jnp.ndarray | None = None,
+) -> tuple[SketchBundle, jnp.ndarray]:
+    """THE staged-ingest step every hot path shares (tpusketch, bench.py,
+    perf harness) — two contracts live here, once:
+
+    - `weights` is the FoldedBatch weights lane as integer per-event
+      weights: pad slots weigh 0, and a capture shim that pre-aggregates
+      runs of equal keys may weigh a slot > 1 — CMS/entropy/events absorb
+      the magnitude, HLL/top-k consult only nonzero-ness. A boolean mask
+      is the weights∈{0,1} special case.
+    - the second return is the FENCE TOKEN: a fresh scalar output the
+      H2DStager blocks on before recycling the staged host block. The
+      bundle itself can never be the fence — the NEXT step donates
+      (deletes) it, and blocking on a donated buffer is an error; the
+      token buffer is never donated downstream.
+    """
+    out = bundle_update_fused(bundle, hh_keys, distinct_keys, dist_keys,
+                              weights.astype(jnp.int32), drops)
+    return out, out.events + 0.0
+
+
+bundle_ingest_jit = jax.jit(bundle_ingest_step, donate_argnums=0)
+
+
 def bundle_digest(b: SketchBundle) -> jnp.ndarray:
     """Harvest digest as ONE u32 array so a harvest tick costs a single
     D2H transfer instead of six (each device→host read through the axon
@@ -107,7 +218,15 @@ def bundle_digest(b: SketchBundle) -> jnp.ndarray:
     ])
 
 
-bundle_digest_jit = jax.jit(bundle_digest)
+# DONATION CONTRACT (ISSUE 10 satellite): bundle_digest must NEVER donate
+# its input. Harvest dispatches this on the LIVE bundle while the
+# double-buffered ingest path keeps updating from the same reference —
+# bundle_update_fused_jit (donate_argnums=0) deletes the buffers it is
+# handed, so a donating digest would leave the next update reading
+# deleted arrays. donate_argnums=() pins the contract explicitly; the
+# regression test lives next to the PR-1 checkpoint-race test
+# (tests/test_telemetry.py::test_harvest_digest_survives_update_pressure).
+bundle_digest_jit = jax.jit(bundle_digest, donate_argnums=())
 
 
 def decode_digest(digest) -> tuple[float, float, float, float,

@@ -2,10 +2,23 @@
 tracing spans and emit a schema-validated PerfRecord.
 
 Where bench.py produces one headline number, this harness attributes the
-same pipeline to its stages — pop → decode → enrich → fold32 → h2d →
-bundle_update → harvest → merge — in the spirit of *Sketch Disaggregation
+same pipeline to its stages, in the spirit of *Sketch Disaggregation
 Across Time and Space*: a regression report that says "fold32 got 40%
-slower" is actionable; "the number went down" is not.
+slower" is actionable; "the number went down" is not. Two pipeline
+shapes exist (ISSUE 10):
+
+- ``classic``: pop → decode → enrich → fold32 → h2d → bundle_update —
+  the pre-fusion hot path, kept measurable so the fused rewrite's win
+  stays a ledger fact instead of a story;
+- ``fused`` (default): pop_folded → h2d_overlap → fused_update — the
+  native SoA exporter fills a pinned staging block with pre-folded
+  uint32 keys (zero per-event Python), a depth-N stager overlaps the
+  H2D transfer of batch k+1 with device compute of batch k, and all
+  sketch planes update in ONE fused device step.
+
+Both append to the SAME (config, metric, platform) ledger series — the
+record's ``extra.pipeline`` string names the shape, so `bench compare`
+baselines old records against new ones instead of forking the series.
 
 Instrumentation reuses the existing telemetry plane end to end:
 
@@ -22,10 +35,13 @@ The platform is acquired FIRST through the bounded, retrying probe
 (utils/platform_probe.acquire_platform_with_retry) and the whole probe
 trail lands in the record's provenance — a degraded run says so in data.
 
-The host side deliberately uses the pure-Python synthetic source: the
-harness measures relative stage cost and regressions against its own
-history, so determinism and portability beat peak rate (bench.py remains
-the headline-throughput instrument; its records share the same ledger).
+Both pipelines prefer the seeded NATIVE synthetic source (classic pops
+Event structs and pays the Python decode+fold, fused drains the folded
+SoA exporter) so fused-vs-classic comparisons isolate the restructure
+rather than the generator; the pure-Python source is the no-toolchain
+fallback, and extra.pipeline records which implementation ran (bench.py
+remains the headline-throughput instrument; its records share the same
+ledger).
 """
 
 from __future__ import annotations
@@ -124,6 +140,7 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                 probe_horizon: float | None = None,
                 trace_out: str | None = None,
                 replay: str | None = None,
+                pipeline: str = "fused",
                 extra_provenance_probe: dict | None = None) -> dict:
     """Run one harness config; returns a validated PerfRecord dict.
 
@@ -134,12 +151,22 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     provenance, so two records claiming the same replay input can be
     checked against each other.
 
+    `pipeline` picks the hot-path shape: "fused" (pop_folded →
+    h2d_overlap → fused_update, the default) or "classic" (pop → decode
+    → enrich → fold32 → h2d → bundle_update, the reference path). The
+    fused host side drains the NATIVE folded exporter when the capture
+    library is available; otherwise it folds the pure-Python source
+    inside the pop_folded stage and says so in extra.pipeline.
+
     The caller decides whether it lands in the ledger (cli/bench.py
     appends by default; tests pass their own tmp path)."""
     cfg = HARNESS_CONFIGS.get(config)
     if cfg is None:
         raise ValueError(f"unknown harness config {config!r} "
                          f"(have: {', '.join(sorted(HARNESS_CONFIGS))})")
+    if pipeline not in ("fused", "classic"):
+        raise ValueError(f"unknown pipeline {pipeline!r} "
+                         "(have: fused, classic)")
     _tm_runs.labels(config=config).inc()
     window = cfg["seconds"] if seconds is None else float(seconds)
 
@@ -154,7 +181,7 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     import jax.numpy as jnp
 
     from ..ops import bundle_merge, topk_values, hll_estimate, entropy_estimate
-    from ..ops.sketches import bundle_init, bundle_update_jit
+    from ..ops.sketches import bundle_ingest_jit, bundle_init, bundle_update_jit
     from ..sources.synthetic import PySyntheticSource
 
     actual = jax.devices()[0].platform
@@ -172,34 +199,87 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     else:
         src = PySyntheticSource(seed=42, vocab=5000, batch_size=batch_n)
 
+    # both pipelines prefer the native synthetic source so the fused-vs-
+    # classic comparison isolates the RESTRUCTURE, not the generator:
+    # classic pops C++ Event structs and pays the Python decode+fold
+    # (the pre-PR hot path), fused drains the folded SoA exporter. The
+    # pure-Python source is the no-toolchain fallback for either, and
+    # extra.pipeline records which implementation ran.
+    native_gen = None
+    if replay_src is None:
+        try:
+            from ..sources.bridge import (SRC_SYNTH_EXEC, NativeCapture,
+                                          native_available)
+            if native_available():
+                native_gen = NativeCapture(SRC_SYNTH_EXEC, seed=42,
+                                           vocab=5000, zipf_s=1.2)
+        except (OSError, RuntimeError, ValueError) as e:
+            log.debug("native synthetic source unavailable (%r); "
+                      "pure-python fallback", e)
+            native_gen = None
+
     def new_bundle():
         return bundle_init(depth=cfg["depth"], log2_width=cfg["log2_width"],
                            hll_p=cfg["hll_p"],
                            entropy_log2_width=cfg["entropy_log2_width"],
                            k=cfg["k"])
 
+    # the shared staged-ingest step (update + fence token + weights-lane
+    # semantics — the donation/fence contract is documented once, on
+    # ops.sketches.bundle_ingest_step)
+    def fused_step(bundle, k, w):
+        return bundle_ingest_jit(bundle, k, k, k, w)
+
     with TRACER.span(f"perf/run/{config}",
                      attrs={"config": config, "platform": actual,
-                            "batch": batch_n}) as run_span:
+                            "batch": batch_n,
+                            "pipeline": pipeline}) as run_span:
         clock = _StageClock(run_span.context)
+
+        pool = stager = None
+        if pipeline == "fused":
+            from ..sources.staging import H2DStager, PinnedBufferPool
+            pool = PinnedBufferPool(batch_n, lanes=2, max_free=4)
+            stager = H2DStager(pool, depth=2)
 
         # warm: compile + source ramp, outside every measured window.
         # Replay journals may carry heterogeneous batch shapes, and each
         # distinct shape is a fresh XLA compile — warm them ALL here or
         # the compile lands inside the measured window (the exact
-        # non-reproducibility --replay exists to eliminate)
+        # non-reproducibility --replay exists to eliminate). The fused
+        # pipeline re-pads every batch into one fixed-capacity pinned
+        # block, so it compiles exactly ONE shape regardless of input.
         bundle = new_bundle()
         if replay_src is not None:
             warm_batches = list({b.capacity: b
                                  for b in replay_src.batches}.values())
+        elif native_gen is not None and pipeline == "classic":
+            warm_batches = [native_gen.generate(batch_n)]
         else:
             warm_batches = [src.generate(batch_n)]
-        for warm in warm_batches:
-            wk = jnp.asarray(_fold32(np.asarray(warm.cols["key_hash"])))
-            wm = jnp.asarray(warm.mask())
+        if pipeline == "fused":
+            blk = pool.get()
+            if native_gen is not None:
+                native_gen.generate_folded(batch_n, out=blk[0])
+            else:
+                wb = warm_batches[0]
+                wk = _fold32(np.asarray(wb.cols["key_hash"][:wb.count],
+                                        dtype=np.uint64))
+                blk[0][:wk.size] = wk
+                blk[0][wk.size:] = 0
+            blk[1][:] = 1
+            k_d, w_d = stager.stage(blk, (blk[0], blk[1]))
             for _ in range(2):
-                bundle = bundle_update_jit(bundle, wk, wk, wk, wm)
-        jax.block_until_ready(bundle.events)
+                bundle, _tok = fused_step(bundle, k_d, w_d)
+            jax.block_until_ready(bundle.events)
+            stager.drain()
+        else:
+            for warm in warm_batches:
+                wk = jnp.asarray(_fold32(np.asarray(warm.cols["key_hash"])))
+                wm = jnp.asarray(warm.mask())
+                for _ in range(2):
+                    bundle = bundle_update_jit(bundle, wk, wk, wk, wm)
+            jax.block_until_ready(bundle.events)
         if replay_src is not None:
             replay_src.reset()  # measure the recorded sequence from 0
             bundle = new_bundle()
@@ -211,37 +291,74 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
         deadline = t_loop + window
         while time.perf_counter() < deadline:
             spans = steps < SPAN_BATCHES
-            with clock.stage("pop", spans):
-                batch = src.generate(batch_n)
-            with clock.stage("decode", spans):
-                keys64 = np.ascontiguousarray(
-                    np.asarray(batch.cols["key_hash"], dtype=np.uint64))
-            with clock.stage("enrich", spans):
-                mask_np = batch.mask()
-                drops += batch.drops
-            with clock.stage("fold32", spans):
-                k32 = _fold32(keys64)
-            with clock.stage("h2d", spans):
-                k = jnp.asarray(k32)
-                mask = jnp.asarray(mask_np)
-            with clock.stage("bundle_update", spans):
-                bundle = bundle_update_jit(bundle, k, k, k, mask)
-                # bound the async backlog so wall clock covers device
-                # completion, not just dispatch (bench.py's honesty rule)
-                if (steps + 1) % cfg["sync_every"] == 0:
-                    jax.block_until_ready(bundle.events)
+            if pipeline == "fused":
+                with clock.stage("pop_folded", spans):
+                    block = pool.get()
+                    if native_gen is not None:
+                        # native exporter fills the pinned lane directly:
+                        # no Event structs, no decode, no fold pass
+                        native_gen.generate_folded(batch_n, out=block[0])
+                        n = batch_n
+                        block[1][:] = 1
+                    else:
+                        b = src.generate(batch_n)
+                        n = b.count
+                        k32 = _fold32(np.asarray(b.cols["key_hash"][:n],
+                                                 dtype=np.uint64))
+                        block[0][:n] = k32
+                        block[0][n:] = 0
+                        block[1][:n] = 1
+                        block[1][n:] = 0
+                        drops += b.drops
+                with clock.stage("h2d_overlap", spans):
+                    # async device put; overlaps the previous batch's
+                    # fused_update, blocks only when >= depth ahead
+                    k, w = stager.stage(block, (block[0], block[1]))
+                with clock.stage("fused_update", spans):
+                    bundle, tok = fused_step(bundle, k, w)
+                    stager.fence(tok)
+                    if (steps + 1) % cfg["sync_every"] == 0:
+                        jax.block_until_ready(bundle.events)
+            else:
+                with clock.stage("pop", spans):
+                    batch = (native_gen.generate(batch_n)
+                             if native_gen is not None
+                             else src.generate(batch_n))
+                with clock.stage("decode", spans):
+                    keys64 = np.ascontiguousarray(
+                        np.asarray(batch.cols["key_hash"], dtype=np.uint64))
+                with clock.stage("enrich", spans):
+                    mask_np = batch.mask()
+                    drops += batch.drops
+                with clock.stage("fold32", spans):
+                    k32 = _fold32(keys64)
+                with clock.stage("h2d", spans):
+                    k = jnp.asarray(k32)
+                    mask = jnp.asarray(mask_np)
+                with clock.stage("bundle_update", spans):
+                    bundle = bundle_update_jit(bundle, k, k, k, mask)
+                    # bound the async backlog so wall clock covers device
+                    # completion, not just dispatch (bench.py's honesty rule)
+                    if (steps + 1) % cfg["sync_every"] == 0:
+                        jax.block_until_ready(bundle.events)
+                n = batch.count
             steps += 1
-            events += batch.count
-            _tm_events.inc(batch.count)
+            events += n
+            _tm_events.inc(n)
             if steps % cfg["harvest_every"] == 0:
                 with clock.stage("harvest", spans):
                     hh_keys, hh_counts = topk_values(bundle.topk)
                     np.asarray(hh_counts)
                     float(hll_estimate(bundle.hll))
                     float(entropy_estimate(bundle.entropy))
-        with clock.stage("bundle_update", steps < SPAN_BATCHES):
+        final_stage = "fused_update" if pipeline == "fused" else "bundle_update"
+        with clock.stage(final_stage, steps < SPAN_BATCHES):
             jax.block_until_ready(bundle.events)
+            if stager is not None:
+                stager.drain()
         elapsed = time.perf_counter() - t_loop
+        if native_gen is not None:
+            native_gen.close()
 
         # merge latency at this config's shape (cluster wire plane)
         merge_jit = jax.jit(bundle_merge)
@@ -264,7 +381,8 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
             "seconds": round(clock.seconds[s], 6),
             "calls": clock.calls[s],
         }
-        if s in ("pop", "decode", "enrich", "fold32", "h2d", "bundle_update"):
+        if s in ("pop", "decode", "enrich", "fold32", "pop_folded", "h2d",
+                 "h2d_overlap", "bundle_update", "fused_update"):
             st["ev_per_s"] = round(
                 events / max(clock.seconds[s], 1e-9), 1)
         if clock.samples.get(s):
@@ -287,6 +405,22 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     prov = build_provenance(actual, bool(acquired.get("degraded")),
                             probe=probe)
     extra_fields: dict = {}
+    # pipeline provenance: the stage list names the shape that ran, and
+    # the host-plane aggregate is the acceptance comparison's numerator
+    # (pop_folded→h2d vs pop→decode→enrich→fold32→h2d stage totals)
+    from .schema import HOST_STAGES
+    host_secs = sum(clock.seconds[s] for s in HOST_STAGES[pipeline])
+    extra_fields["host_plane_ev_per_s"] = round(
+        events / max(host_secs, 1e-9), 1)
+    impl = ("native" if native_gen is not None
+            else "replay" if replay_src is not None else "py")
+    if pipeline == "fused":
+        extra_fields["pipeline"] = (
+            f"pop_folded({'py-fold' if impl == 'py' else impl})"
+            "->h2d_overlap(depth2)->fused_update")
+    else:
+        extra_fields["pipeline"] = (
+            f"pop({impl})->decode->enrich->fold32->h2d->bundle_update")
     if replay_src is not None:
         # the journal digest IS part of the number's meaning: same
         # config + same digest → directly comparable records

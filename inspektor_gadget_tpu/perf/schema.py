@@ -21,10 +21,25 @@ from typing import Any
 
 SCHEMA_ID = "ig-tpu/perf-record/v1"
 
-# canonical stage order of the ingest pipeline (ISSUE: pop→decode→enrich→
-# fold32→H2D→bundle_update→harvest→merge); records may carry any subset
-STAGES = ("pop", "decode", "enrich", "fold32", "h2d", "bundle_update",
-          "harvest", "merge")
+# canonical stage order of the ingest pipeline; records may carry any
+# subset. Two pipeline shapes share this table (the record's
+# extra.pipeline string says which one ran, so series keys — config +
+# metric + platform — never fork):
+#   classic: pop → decode → enrich → fold32 → h2d → bundle_update
+#   fused  : pop_folded → h2d_overlap → fused_update   (ISSUE 10: the
+#            zero-copy SoA exporter fills pinned blocks, the depth-N
+#            stager overlaps transfers with compute, and all sketch
+#            planes update in one fused device step)
+STAGES = ("pop", "decode", "enrich", "fold32", "pop_folded", "h2d",
+          "h2d_overlap", "bundle_update", "fused_update", "harvest",
+          "merge")
+
+# stages whose seconds count as HOST-plane ingest cost (the acceptance
+# comparison pop_folded→h2d vs pop→decode→enrich→fold32 sums these)
+HOST_STAGES = {
+    "classic": ("pop", "decode", "enrich", "fold32", "h2d"),
+    "fused": ("pop_folded", "h2d_overlap"),
+}
 
 DIRECTIONS = ("higher_better", "lower_better")
 PLATFORMS = ("tpu", "cpu", "gpu", "none", "unknown")

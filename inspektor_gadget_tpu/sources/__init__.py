@@ -7,7 +7,8 @@ same interface. Replay sources make every test deterministic — the analogue
 of the reference's fake-container runners (internal/test/runner.go).
 """
 
-from .batch import EventBatch, BATCH_COLUMNS
+from .batch import EventBatch, BATCH_COLUMNS, FoldedBatch, FOLDED_LANES
+from .staging import H2DStager, PinnedBufferPool
 from .bridge import (
     NativeCapture,
     native_available,
@@ -30,7 +31,8 @@ from .bridge import (
 from .synthetic import PySyntheticSource
 
 __all__ = [
-    "EventBatch", "BATCH_COLUMNS",
+    "EventBatch", "BATCH_COLUMNS", "FoldedBatch", "FOLDED_LANES",
+    "H2DStager", "PinnedBufferPool",
     "NativeCapture", "native_available", "make_cfg", "sources_stats",
     "SRC_SYNTH_EXEC", "SRC_SYNTH_TCP", "SRC_SYNTH_DNS",
     "SRC_PROC_EXEC", "SRC_PROC_TCP",

@@ -55,3 +55,50 @@ class EventBatch:
             return ""
         raw = bytes(self.comm[i])
         return raw.split(b"\0", 1)[0].decode("utf-8", "replace")
+
+
+# Lane order of the folded SoA block — rows 0..2 of one (lanes >= 3,
+# capacity) uint32 array per batch: a single pinned allocation carries
+# all lanes, so one pool slot == one batch and the native exporter fills
+# all three with one call. Blocks may carry extra rows (tpusketch's
+# staging pool allocates 4 lanes so the same pool serves the EventBatch
+# path); a block's shape must match the pool it came from or put()
+# drops it.
+FOLDED_LANES = ("keys", "weights", "mntns")
+
+
+@dataclasses.dataclass
+class FoldedBatch:
+    """Pre-folded struct-of-arrays batch — the sketch plane's native unit.
+
+    Produced by `ig_source_pop_folded` (native/api.cc) draining a capture
+    ring directly into caller-owned uint32 lanes: `keys` is the xor-folded
+    key_hash (the sketch key width, no Python decode/fold pass), `weights`
+    the per-event weight (1 today; reserved for capture-side aggregation),
+    `mntns` the xor-folded mount-ns id (exact for real ns inodes < 2^32 —
+    the late-enrichment display key). The lanes are rows 0..2 of ONE
+    pinned (lanes >= 3, capacity) block owned by a PinnedBufferPool slot;
+    consumers must release the block back to the SAME pool once the H2D
+    transfer completes.
+    """
+
+    lanes: "np.ndarray"        # (>=3, capacity) uint32 — pool-owned block
+    count: int                 # valid rows (rest is padding)
+    seq: int = 0               # first event's sequence number
+    drops: int = 0             # cumulative upstream drops at pop time
+
+    @property
+    def capacity(self) -> int:
+        return self.lanes.shape[1]
+
+    @property
+    def keys(self) -> "np.ndarray":
+        return self.lanes[0]
+
+    @property
+    def weights(self) -> "np.ndarray":
+        return self.lanes[1]
+
+    @property
+    def mntns(self) -> "np.ndarray":
+        return self.lanes[2]

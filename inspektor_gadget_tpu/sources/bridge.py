@@ -18,7 +18,7 @@ from pathlib import Path
 
 import numpy as np
 
-from .batch import EventBatch
+from .batch import EventBatch, FoldedBatch
 
 SRC_SYNTH_EXEC = 1
 SRC_SYNTH_TCP = 2
@@ -136,6 +136,8 @@ def _load_and_bind(rebuild: bool):
     lib.ig_source_pop_batch.argtypes = [u64, i64] + [p64] * 5 + [p32] * 4 + [
         ctypes.c_char_p]
     lib.ig_source_pop_batch.restype = i64
+    lib.ig_source_pop_folded.argtypes = [u64, i64, p32, p32, p32]
+    lib.ig_source_pop_folded.restype = i64
     lib.ig_source_drops.argtypes = [u64]
     lib.ig_source_drops.restype = u64
     lib.ig_source_produced.argtypes = [u64]
@@ -367,6 +369,26 @@ class NativeCapture:
         b.drops = int(self._lib.ig_source_drops(self._h))
         return b
 
+    def pop_folded(self, block: np.ndarray) -> FoldedBatch:
+        """Drain the ring straight into a (3, capacity) pre-folded SoA
+        block — keys/weights/mntns uint32 lanes, filled by ONE native
+        crossing (`ig_source_pop_folded`) with zero per-event Python
+        work. `block` is typically a PinnedBufferPool slot wrapped
+        zero-copy (np.frombuffer over the pinned mmap), so the lanes the
+        C++ exporter writes ARE the H2D staging buffer: no Event structs,
+        no decode, no separate fold pass."""
+        if block.shape[0] < 3 or block.dtype != np.uint32:
+            raise ValueError("pop_folded needs a (3, capacity) uint32 block")
+        got = self._lib.ig_source_pop_folded(
+            self._h, block.shape[1],
+            _p32(block[0]), _p32(block[1]), _p32(block[2]))
+        if got < 0:
+            raise RuntimeError("pop_folded on destroyed source")
+        fb = FoldedBatch(lanes=block, count=int(got), seq=self._seq,
+                         drops=int(self._lib.ig_source_drops(self._h)))
+        self._seq += int(got)
+        return fb
+
     def generate(self, n: int) -> EventBatch:
         """Synchronous synthetic generation (bench path; no capture thread)."""
         b = EventBatch.alloc(n, with_comm=False)
@@ -389,9 +411,16 @@ class NativeCapture:
     def generate_folded(self, n: int, out: np.ndarray | None = None) -> np.ndarray:
         """Synchronous synthetic generation of xor-folded uint32 keys (the
         sketch plane's native width) straight into a staging buffer — no
-        Event structs, no separate fold pass (bench hot path)."""
-        if out is None or out.size < n:
+        Event structs, no separate fold pass (bench hot path). A caller
+        buffer that cannot hold n uint32 keys is an ERROR, not a silent
+        fresh allocation: hot-path callers ignore the return value and
+        would otherwise sketch the buffer's stale previous contents."""
+        if out is None:
             out = np.empty(n, dtype=np.uint32)
+        elif out.size < n or out.dtype != np.uint32:
+            raise ValueError(
+                f"generate_folded needs a uint32 buffer of >= {n} "
+                f"entries, got {out.dtype}[{out.size}]")
         got = self._lib.ig_synth_generate_folded(self._h, n, _p32(out))
         if got < 0:
             raise RuntimeError("generate_folded on non-synthetic source")

@@ -27,11 +27,11 @@ needs_root = pytest.mark.skipif(os.geteuid() != 0, reason="needs root")
 
 def test_probe_windows_names_and_shape():
     windows = probe_windows()
-    expected = {"native_lib", "fanotify", "perf", "kmsg", "ptrace",
-                "sock_diag", "netlink_proc", "af_packet", "mountinfo",
-                "procfs", "blktrace", "tcpinfo", "audit", "captrace",
-                "fstrace", "sockstate", "sigtrace", "container_runtime",
-                "capture_dir", "history_dir"}
+    expected = {"native_lib", "native_toolchain", "fanotify", "perf",
+                "kmsg", "ptrace", "sock_diag", "netlink_proc", "af_packet",
+                "mountinfo", "procfs", "blktrace", "tcpinfo", "audit",
+                "captrace", "fstrace", "sockstate", "sigtrace",
+                "container_runtime", "capture_dir", "history_dir"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail

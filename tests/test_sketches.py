@@ -325,6 +325,163 @@ def test_range_query_answers_are_split_invariant():
         assert est == single.distinct()
 
 
+# -- fused bundle_update parity (ISSUE 10 tentpole) --------------------------
+# The fused Pallas kernel must be BIT-IDENTICAL to the separate reference
+# ops — CMS table, HLL registers, entropy counts, top-k state, totals.
+# On CPU CI the kernel itself runs in the Pallas interpreter
+# (_bundle_update_pallas(interpret=True)); on TPU the same code path is
+# the production fused step.
+
+_BUNDLE_LEAVES = ("cms.table", "cms.total", "hll.registers",
+                  "entropy.counts", "topk.keys", "topk.counts",
+                  "events", "drops")
+
+
+def _leaf(bundle, dotted):
+    out = bundle
+    for part in dotted.split("."):
+        out = getattr(out, part)
+    return np.asarray(out)
+
+
+def _assert_bundles_bit_identical(a, b, ctx=""):
+    for name in _BUNDLE_LEAVES:
+        assert np.array_equal(_leaf(a, name), _leaf(b, name)), (ctx, name)
+
+
+def _streams(rng, n):
+    return (jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32)),
+            jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32)),
+            jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32)))
+
+
+def test_fused_kernel_bit_identical_across_widths_and_masks():
+    """Interpret-mode fused kernel vs the reference composition across
+    sketch widths, depths, and ragged (odd-count) masks."""
+    from inspektor_gadget_tpu.ops.sketches import _bundle_update_pallas
+
+    rng = np.random.default_rng(21)
+    cases = [  # (depth, log2w, ent_log2w, hll_p, n, valid)
+        (4, 10, 8, 8, 256, 256),
+        (2, 12, 10, 7, 512, 501),   # odd valid count under the pad mask
+        (5, 11, 6, 10, 512, 384),
+    ]
+    for depth, log2w, entw, p, n, valid in cases:
+        b0 = bundle_init(depth=depth, log2_width=log2w, hll_p=p,
+                         entropy_log2_width=entw, k=16)
+        hh, distinct, dist = _streams(rng, n)
+        mask = jnp.asarray(np.arange(n) < valid)
+        drops = jnp.float32(2)
+        ref = bundle_update(b0, hh, distinct, dist, mask, drops)
+        fused = _bundle_update_pallas(b0, hh, distinct, dist, mask, drops,
+                                      interpret=True)
+        _assert_bundles_bit_identical(ref, fused, ctx=(depth, log2w, entw, p))
+        # and a second absorbed batch on top of live state
+        hh2, d2, dd2 = _streams(rng, n)
+        ref2 = bundle_update(ref, hh2, d2, dd2, mask)
+        fused2 = _bundle_update_pallas(fused, hh2, d2, dd2, mask,
+                                       interpret=True)
+        _assert_bundles_bit_identical(ref2, fused2, ctx="second batch")
+
+
+def test_fused_dispatch_selection_and_fallback():
+    """bundle_update_fused picks the kernel only for aligned shapes on a
+    TPU backend; odd batches and narrow configs take the reference path
+    — and the entry point's result equals bundle_update either way."""
+    from inspektor_gadget_tpu.ops import bundle_update_fused, fused_supported
+
+    b = bundle_init(depth=4, log2_width=12, hll_p=10,
+                    entropy_log2_width=8, k=8)
+    assert fused_supported(b, 512)
+    assert not fused_supported(b, 999)        # odd batch size
+    narrow = bundle_init(depth=4, log2_width=8, hll_p=6,
+                         entropy_log2_width=6, k=8)
+    assert not fused_supported(narrow, 512)   # widest plane < one tile
+    rng = np.random.default_rng(22)
+    for n in (999, 512):                      # ragged AND aligned
+        hh, distinct, dist = _streams(rng, n)
+        mask = jnp.asarray(np.arange(n) < n - 7)
+        ref = bundle_update(b, hh, distinct, dist, mask)
+        got = bundle_update_fused(b, hh, distinct, dist, mask)
+        _assert_bundles_bit_identical(ref, got, ctx=n)
+
+
+def test_fused_update_under_vmap_and_psum_merge():
+    """Per-node fused updates must vmap cleanly and their states must
+    merge exactly like reference states — both by pairwise bundle_merge
+    and by the device psum/pmax collectives over a named axis."""
+    from inspektor_gadget_tpu.ops import bundle_update_fused
+    from inspektor_gadget_tpu.ops.countmin import cms_psum
+    from inspektor_gadget_tpu.ops.entropy import entropy_psum
+    from inspektor_gadget_tpu.ops.hll import hll_pmax
+
+    rng = np.random.default_rng(23)
+    n = 512
+    b0 = bundle_init(depth=4, log2_width=10, hll_p=8,
+                     entropy_log2_width=8, k=16)
+    k1, _, _ = _streams(rng, n)
+    k2, _, _ = _streams(rng, n)
+    mask = jnp.ones(n, bool)
+
+    stacked0 = jax.tree.map(lambda x: jnp.stack([x, x]), b0)
+    keys = jnp.stack([k1, k2])
+    out = jax.vmap(lambda b, k: bundle_update_fused(b, k, k, k, mask))(
+        stacked0, keys)
+    ref1 = bundle_update(b0, k1, k1, k1, mask)
+    ref2 = bundle_update(b0, k2, k2, k2, mask)
+    for i, ref in enumerate((ref1, ref2)):
+        got = jax.tree.map(lambda x: x[i], out)
+        _assert_bundles_bit_identical(ref, got, ctx=f"vmap lane {i}")
+
+    # psum/pmax collectives over the stacked axis ≡ pairwise merge
+    merged = bundle_merge(ref1, ref2)
+    cms_all = jax.vmap(lambda s: cms_psum(s, "n"), axis_name="n")(out.cms)
+    hll_all = jax.vmap(lambda s: hll_pmax(s, "n"), axis_name="n")(out.hll)
+    ent_all = jax.vmap(lambda s: entropy_psum(s, "n"),
+                       axis_name="n")(out.entropy)
+    assert jnp.array_equal(cms_all.table[0], merged.cms.table)
+    assert jnp.array_equal(hll_all.registers[0], merged.hll.registers)
+    assert jnp.array_equal(ent_all.counts[0], merged.entropy.counts)
+
+
+def test_window_digests_identical_on_fused_and_reference_paths():
+    """Replay determinism across paths (ISSUE 10 satellite): the SAME
+    recorded batch stream sealed into history windows must produce
+    byte-identical window digests whether the bundle state came from the
+    reference ops or the fused kernel — `replay --verify` cannot hold
+    otherwise. Digests are the history plane's state-only content hash,
+    so this pins bit-equality end to end, not just array equality."""
+    from inspektor_gadget_tpu.history import window_digest
+    from inspektor_gadget_tpu.history.window import SealedWindow
+    from inspektor_gadget_tpu.ops.sketches import _bundle_update_pallas
+
+    rng = np.random.default_rng(24)
+    n = 256
+    batches = [_streams(rng, n)[0] for _ in range(3)]
+    mask = jnp.ones(n, bool)
+
+    def seal(path):
+        b = bundle_init(depth=2, log2_width=10, hll_p=8,
+                        entropy_log2_width=8, k=8)
+        for k in batches:
+            if path == "fused":
+                b = _bundle_update_pallas(b, k, k, k, mask, interpret=True)
+            else:
+                b = bundle_update(b, k, k, k, mask)
+        win = SealedWindow(
+            gadget="trace/parity", node="n0", run_id="r", window=1,
+            start_ts=1.0, end_ts=2.0, events=int(b.events), drops=0,
+            cms=np.asarray(b.cms.table, dtype=np.int32),
+            hll=np.asarray(b.hll.registers, dtype=np.int32),
+            ent=np.asarray(b.entropy.counts, dtype=np.float32),
+            topk_keys=np.asarray(b.topk.keys),
+            topk_counts=np.asarray(b.topk.counts, dtype=np.int64),
+            slices={})
+        return window_digest(win)
+
+    assert seal("reference") == seal("fused")
+
+
 def test_windowed_cms_merge_and_jit():
     import jax as _jax
     from inspektor_gadget_tpu.ops.window import (

@@ -408,6 +408,104 @@ def test_checkpoint_snapshots_bundle_under_update_pressure(sketch_instance):
 
 
 # ---------------------------------------------------------------------------
+# pinned-pool / H2D staging telemetry + digest donation pin (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+def test_ingest_pool_counters_and_inflight_gauge(sketch_instance):
+    """The staging plane must account itself: fresh blocks count as pool
+    misses, steady-state recycling as hits, and the in-flight H2D gauge
+    returns to its baseline once the stager drains — all visible in the
+    Prometheus exposition."""
+    from inspektor_gadget_tpu.sources import staging
+    from inspektor_gadget_tpu.sources.synthetic import PySyntheticSource
+    from inspektor_gadget_tpu.telemetry import render_prometheus
+
+    _tmp, inst = sketch_instance
+    hits0 = staging._tm_pool_hits.value
+    miss0 = staging._tm_pool_misses.value
+    inflight0 = staging._tm_inflight.value
+
+    src = PySyntheticSource(seed=5, batch_size=512)
+    for _ in range(8):
+        inst.enrich_batch(src.generate(512))
+    assert staging._tm_pool_misses.value > miss0, \
+        "first staging blocks must be accounted as pool misses"
+    assert staging._tm_pool_hits.value > hits0, \
+        "steady-state ingest must recycle pinned blocks (pool hits)"
+    assert inst._stager is not None
+    inst._stager.drain()
+    assert staging._tm_inflight.value == inflight0, \
+        "drained stager must return the in-flight gauge to baseline"
+
+    text = render_prometheus()
+    assert "ig_ingest_pool_hits_total" in text
+    assert "ig_ingest_pool_misses_total" in text
+    assert "ig_ingest_h2d_inflight" in text
+
+
+def test_ingest_folded_roundtrip_recycles_blocks(sketch_instance):
+    """The zero-copy SoA entry point: FoldedBatch lanes from
+    folded_block() must absorb into the bundle, recycle through the
+    instance's pinned pool (same shape, so put() keeps them), and
+    harvest the exact event total."""
+    from inspektor_gadget_tpu.sources.batch import FoldedBatch
+
+    _tmp, inst = sketch_instance
+    total = 0
+    for i in range(4):
+        block = inst.folded_block()
+        n = 300 + i
+        block[0][:n] = np.arange(1, n + 1, dtype=np.uint32)
+        block[1][:n] = 1
+        block[2][:n] = 101
+        inst.ingest_folded(FoldedBatch(lanes=block, count=n))
+        total += n
+    assert inst._stager is not None
+    inst._stager.drain()
+    assert inst._pool.free_blocks() > 0, \
+        "folded blocks must recycle through the instance pool"
+    s = inst.harvest()
+    assert s.events == total
+
+
+def test_harvest_digest_survives_update_pressure(sketch_instance):
+    """Donation/aliasing pin (ISSUE 10 satellite, next to the PR-1
+    checkpoint-race test above): bundle_digest_jit must never donate its
+    input — harvest dispatches it on the LIVE bundle while the
+    double-buffered ingest path keeps issuing donating updates, so a
+    donating digest would read deleted buffers exactly like the old
+    checkpoint race did."""
+    from inspektor_gadget_tpu.sources.synthetic import PySyntheticSource
+
+    _tmp, inst = sketch_instance
+    src = PySyntheticSource(seed=7, batch_size=512)
+    stop = threading.Event()
+    errors = []
+
+    def pump():
+        try:
+            while not stop.is_set():
+                inst.enrich_batch(src.generate(512))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=pump)
+    t.start()
+    try:
+        deadline = time.monotonic() + 1.5
+        harvests = 0
+        while time.monotonic() < deadline:
+            s = inst.harvest()
+            assert s.events >= 0
+            harvests += 1
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    assert not errors, errors
+    assert harvests > 0
+
+
+# ---------------------------------------------------------------------------
 # sketch-history plane telemetry (ISSUE 6 satellite)
 # ---------------------------------------------------------------------------
 
