@@ -9,6 +9,7 @@ grpc target here), GetCatalog with client-side cache fallback
 from __future__ import annotations
 
 import json
+import logging
 import os
 import queue
 import threading
@@ -26,20 +27,43 @@ CATALOG_CACHE = Path.home() / ".ig-tpu" / "catalog.json"
 
 
 class AgentClient:
-    def __init__(self, target: str, node_name: str = "", dialer=None):
+    def __init__(self, target: str, node_name: str = "", dialer=None,
+                 rpc_deadline: float | None = None):
         """dialer: how to reach the agent (default DirectDialer). An
         ExecTunnelDialer reaches agents with no routable address by
         tunneling over a subprocess's stdio — the reference's
-        k8s-exec-dialer contract (k8s-exec-dialer.go:1-132)."""
+        k8s-exec-dialer contract (k8s-exec-dialer.go:1-132).
+
+        rpc_deadline bounds every unary RPC (catalog, dump_state,
+        list/fetch, recording lifecycle): an unresponsive agent fails the
+        call with DEADLINE_EXCEEDED instead of wedging the caller.
+        Default $IG_RPC_DEADLINE or 30s."""
         from .dialer import DirectDialer
         self.target = target
         self.node_name = node_name or target
         self.dialer = dialer or DirectDialer()
+        if rpc_deadline is None:
+            rpc_deadline = float(os.environ.get("IG_RPC_DEADLINE",
+                                                CONNECT_TIMEOUT))
+        if rpc_deadline <= 0:
+            raise ValueError(f"rpc_deadline must be > 0, got {rpc_deadline}")
+        self.rpc_deadline = rpc_deadline
         self.channel = self.dialer.dial(target)
 
     def close(self) -> None:
         self.channel.close()
         self.dialer.close()
+
+    def reconnect(self) -> None:
+        """Tear down the (possibly wedged) channel and dial a fresh one.
+        The supervisor calls this between retry attempts so a channel
+        stuck in TRANSIENT_FAILURE backoff doesn't slow the resume."""
+        try:
+            self.channel.close()
+        except Exception as e:  # noqa: BLE001 — a dead channel may refuse close
+            logging.getLogger("ig-tpu.client").debug(
+                "channel close before redial failed: %r", e)
+        self.channel = self.dialer.dial(self.target)
 
     # -- catalog ------------------------------------------------------------
 
@@ -50,7 +74,7 @@ class AgentClient:
             response_deserializer=wire.identity_deserializer,
         )
         try:
-            reply = method(wire.encode_msg({}), timeout=CONNECT_TIMEOUT)
+            reply = method(wire.encode_msg({}), timeout=self.rpc_deadline)
             header, _ = wire.decode_msg(reply)
             catalog = header["catalog"]
             try:  # cache for offline flag rendering (ref: catalog cache)
@@ -80,24 +104,57 @@ class AgentClient:
         on_summary: Callable[[str, dict], None] | None = None,
         on_alert: Callable[[str, dict], None] | None = None,
         on_log: Callable[[str, int, str, dict], None] | None = None,
+        on_message: Callable[[str, int, int], None] | None = None,
         stop_event: threading.Event | None = None,
         trace_ctx=None,
+        run_id: str | None = None,
+        resumable: bool = False,
+        linger: float | None = None,
+        ring: int | None = None,
+        resume_from: int | None = None,
     ) -> dict:
         """Blocking run; returns {'result': bytes|None, 'error': str|None,
-        'gaps': int, 'dropped': int}. trace_ctx (a telemetry SpanContext)
-        rides the run request as a traceparent so the agent's server spans
-        join the caller's trace; on_log receives (node, severity, msg,
-        header) — the header carries the remote run_id/trace_id."""
+        'gaps': int, 'dropped': int, 'records': int, 'last_seq': int,
+        'resume': dict|None, 'unknown_run': bool, 'gadget_error': bool}.
+        trace_ctx (a telemetry SpanContext) rides the run request as a
+        traceparent so the agent's server spans join the caller's trace;
+        on_log receives (node, severity, msg, header) — the header
+        carries the remote run_id/trace_id; on_message(node, seq, type)
+        fires for every seq-bearing stream message (supervision's
+        record-cadence hook).
+
+        resumable=True asks the agent to keep the run alive for `linger`
+        seconds after a disconnect, retaining the last `ring` messages
+        for replay; resume_from re-attaches to an existing run (run_id
+        required) and receives messages after that seq — the agent
+        answers with an EV_RESUME_ACK (surfaced as out['resume']) or
+        `unknown_run` when it has nothing to resume (it restarted)."""
         method = self.channel.stream_stream(
             "/igtpu.GadgetManager/RunGadget",
             request_serializer=wire.identity_serializer,
             response_deserializer=wire.identity_deserializer,
         )
         ctrl_q: queue.Queue = queue.Queue()
-        ctrl_q.put(wire.encode_msg(wire.inject_span({"run": {
-            "category": category, "name": name, "params": params or {},
-            "timeout": timeout, "output": list(outputs),
-        }}, trace_ctx)))
+        if resume_from is not None:
+            if not run_id:
+                raise ValueError("resume_from requires run_id")
+            first_msg = {"resume": {"run_id": run_id,
+                                    "last_seq": int(resume_from)}}
+        else:
+            run: dict = {
+                "category": category, "name": name, "params": params or {},
+                "timeout": timeout, "output": list(outputs),
+            }
+            if run_id:
+                run["run_id"] = run_id
+            if resumable:
+                run["resumable"] = True
+                if linger is not None:
+                    run["linger"] = float(linger)
+                if ring is not None:
+                    run["ring"] = int(ring)
+            first_msg = {"run": run}
+        ctrl_q.put(wire.encode_msg(wire.inject_span(first_msg, trace_ctx)))
 
         def requests() -> Iterator[bytes]:
             while True:
@@ -113,8 +170,13 @@ class AgentClient:
                 ctrl_q.put(None)
             threading.Thread(target=stopper, daemon=True).start()
 
-        out = {"result": None, "error": None, "gaps": 0, "dropped": 0}
-        last_seq = 0
+        out = {"result": None, "error": None, "gaps": 0, "dropped": 0,
+               "records": 0, "last_seq": int(resume_from or 0),
+               "resume": None, "unknown_run": False, "gadget_error": False}
+        # resuming: seq numbering continues from what we already hold, so
+        # gap detection spans the outage — a replay ring that overflowed
+        # shows up as a gap here (and as `missed` in the resume ack)
+        last_seq = int(resume_from or 0)
         call = method(requests(), timeout=None if timeout == 0 else timeout + RESULT_TIMEOUT)
         try:
             for msg in call:
@@ -124,6 +186,11 @@ class AgentClient:
                     out["gaps"] += seq - last_seq - 1  # ref: seq-gap :312-314
                 if seq:
                     last_seq = seq
+                    out["last_seq"] = seq
+                    out["records"] += 1
+                    if on_message is not None:
+                        on_message(self.node_name, seq,
+                                   header.get("type", 0))
                 t = header.get("type", 0)
                 sev = t >> wire.EV_LOG_SHIFT
                 if sev:
@@ -148,10 +215,20 @@ class AgentClient:
                 elif t == wire.EV_RESULT:
                     out["error"] = header.get("error")
                     out["result"] = payload or None
+                    if header.get("error"):
+                        out["gadget_error"] = True
                 elif t == wire.EV_CONTROL_ACK:
                     out["dropped"] = header.get("dropped", 0)
+                elif t == wire.EV_RESUME_ACK:
+                    out["resume"] = header.get("resume", {})
                 elif "error" in header:
                     out["error"] = header["error"]
+                    if header.get("unknown_run"):
+                        out["unknown_run"] = True
+                    else:
+                        # run-setup refusals (unknown gadget, bad params)
+                        # are deterministic — retrying replays the failure
+                        out["gadget_error"] = True
         except grpc.RpcError as e:
             if e.code() != grpc.StatusCode.CANCELLED:
                 out["error"] = f"{e.code().name}: {e.details()}"
@@ -192,7 +269,7 @@ class AgentClient:
         )
         req = {"max_spans": max_spans} if max_spans else {}
         h, _ = wire.decode_msg(method(wire.encode_msg(req),
-                                      timeout=CONNECT_TIMEOUT))
+                                      timeout=self.rpc_deadline))
         return h
 
     def flight_record(self, max_spans: int = 0) -> dict:
@@ -235,7 +312,7 @@ class AgentClient:
                 reply = method(wire.encode_msg(
                     {"recording_id": recording_id, "file": rel_path,
                      "offset": offset, "limit": chunk}),
-                    timeout=CONNECT_TIMEOUT)
+                    timeout=self.rpc_deadline)
                 h, payload = wire.decode_msg(reply)
                 if h.get("error"):
                     raise RuntimeError(h["error"])
@@ -305,7 +382,7 @@ class AgentClient:
                 "gadget": gadget, "start_ts": start_ts, "end_ts": end_ts,
                 "start_seq": start_seq, "end_seq": end_seq, "key": key,
                 "offset": offset, "max_bytes": chunk_bytes}),
-                timeout=CONNECT_TIMEOUT)
+                timeout=self.rpc_deadline)
             h, payload = wire.decode_msg(reply)
             if h.get("error"):
                 raise RuntimeError(h["error"])
@@ -329,8 +406,10 @@ class AgentClient:
             request_serializer=wire.identity_serializer,
             response_deserializer=wire.identity_deserializer,
         )
+        # per-RPC deadline: an unresponsive agent fails this call with
+        # DEADLINE_EXCEEDED instead of hanging dump_state/list_windows
         h, _ = wire.decode_msg(method(wire.encode_msg(msg),
-                                      timeout=CONNECT_TIMEOUT))
+                                      timeout=self.rpc_deadline))
         if h.get("error"):
             raise RuntimeError(h["error"])
         return h

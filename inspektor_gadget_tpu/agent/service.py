@@ -14,10 +14,12 @@ message bodies use wire.py framing.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import queue
 import threading
+import time
 from concurrent import futures
 from typing import Iterator
 
@@ -38,6 +40,13 @@ from ..utils.logger import StreamLogHandler, StreamLogger
 from . import wire
 
 EVENT_BUFFER = 1024  # ref: service.go:134 bounded buffer, drop-on-full
+
+# resume plane defaults: how many outbound messages a detached run
+# retains for ring replay, and how long a resumable run keeps running
+# with no client attached before it cancels itself. Both are per-run
+# overridable via the run request (`ring` / `linger`).
+RESUME_RING = 1024
+RESUME_LINGER = 10.0
 
 log = logging.getLogger("ig-tpu.agent")
 
@@ -77,6 +86,187 @@ _tm_stream_q = gauge("ig_agent_stream_queue_depth",
                      "RunGadget out-queue depth at last push (backpressure)",
                      ("gadget",))
 _tm_active_runs = gauge("ig_agent_active_runs", "gadget runs in flight")
+_tm_stream_resumes = counter("ig_agent_stream_resumes_total",
+                             "RunGadget streams re-attached via resume",
+                             ("gadget",))
+_tm_detached_runs = gauge("ig_agent_detached_runs",
+                          "resumable runs currently lingering with no "
+                          "client attached")
+
+
+class RunStream:
+    """Per-run outbound stream state that survives client disconnects.
+
+    The serving RPC generator used to own the queue and the seq counter,
+    so a dropped connection destroyed both and the run with them. This
+    object outlives any single RPC: every outbound message gets its seq
+    here and lands in a bounded replay ring; an attached client also
+    gets it on a live queue. When the client vanishes the run DETACHES
+    (ring keeps filling) and lingers for `linger` seconds awaiting a
+    `resume {run_id, last_seq}` re-attach, which replays ring messages
+    with seq > last_seq — no duplicates by construction — and reports
+    how many seqs overflowed the ring (`missed`, healed upstream by
+    sealed-window backfill). Non-resumable runs keep the old semantics:
+    disconnect cancels the run immediately.
+    """
+
+    def __init__(self, run_id: str, gadget: str, *, resumable: bool = False,
+                 linger: float = RESUME_LINGER, ring_size: int = RESUME_RING):
+        self.run_id = run_id
+        self.gadget = gadget
+        self.resumable = bool(resumable)
+        self.linger = float(linger)
+        self._mu = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(int(ring_size), 1))
+        self._q: queue.Queue | None = None
+        self._gen = 0
+        self.seq = 0
+        self.dropped = 0
+        self.done = False
+        self.detached_at: float | None = None
+        self.attaches = 0
+        self._linger_timer: threading.Timer | None = None
+        self.ctx = None  # the run's GadgetContext, set before first push
+        self._m_msgs = _tm_stream_msgs.labels(gadget=gadget)
+        self._m_dropped = _tm_stream_dropped.labels(gadget=gadget)
+        self._m_qdepth = _tm_stream_q.labels(gadget=gadget)
+
+    def is_attached(self) -> bool:
+        with self._mu:
+            return self._q is not None
+
+    def owns(self, gen: int) -> bool:
+        with self._mu:
+            return self._gen == gen and self._q is not None
+
+    def push(self, kind: int, header: dict, payload: bytes = b"",
+             force: bool = False) -> None:
+        """Stamp seq, retain in the ring, deliver to the live client if
+        one is attached. `force` (trailers: EV_RESULT / EV_CONTROL_ACK)
+        evicts the oldest queued message instead of dropping the new one
+        — a full queue must not eat the run's result."""
+        with self._mu:
+            self.seq += 1
+            msg = wire.encode_msg({**header, "seq": self.seq, "type": kind},
+                                  payload)
+            self._ring.append((self.seq, msg))
+            self._m_msgs.inc()
+            q = self._q
+            if q is None:
+                return
+            try:
+                q.put_nowait(msg)
+                self._m_qdepth.set(q.qsize())
+            except queue.Full:
+                if not force:
+                    self.dropped += 1  # ref: service.go:160-167 drop-on-full
+                    self._m_dropped.inc()
+                    return
+                while True:
+                    try:
+                        q.put_nowait(msg)
+                        return
+                    except queue.Full:
+                        try:
+                            q.get_nowait()
+                            self.dropped += 1
+                            self._m_dropped.inc()
+                        except queue.Empty:
+                            pass
+
+    def attach(self, last_seq: int) -> tuple[queue.Queue, int, dict]:
+        """(Re-)attach a client that holds everything up to last_seq.
+        Returns (live queue, attach generation, resume-ack dict)."""
+        with self._mu:
+            if self._linger_timer is not None:
+                self._linger_timer.cancel()
+                self._linger_timer = None
+            if self.detached_at is not None:
+                _tm_detached_runs.dec()
+                self.detached_at = None
+            replay = [(s, m) for s, m in self._ring if s > last_seq]
+            if replay:
+                missed = max(0, replay[0][0] - last_seq - 1)
+            else:
+                missed = max(0, self.seq - last_seq)
+            q: queue.Queue = queue.Queue(
+                maxsize=EVENT_BUFFER + len(replay) + 8)
+            for _s, m in replay:
+                q.put_nowait(m)
+            if self.done:
+                q.put_nowait(None)
+            self._q = q
+            self._gen += 1
+            self.attaches += 1
+            ack = {"run_id": self.run_id, "last_seq": int(last_seq),
+                   "missed": int(missed), "replayed": len(replay),
+                   "seq": self.seq, "attach": self.attaches}
+            return q, self._gen, ack
+
+    def detach(self, gen: int) -> None:
+        """A serving RPC ended. Only the CURRENT attachment detaches (a
+        generator superseded by a newer resume is a no-op). Resumable
+        live runs linger awaiting a re-attach; everything else keeps the
+        old cancel-on-disconnect contract."""
+        ctx = None
+        with self._mu:
+            if gen != self._gen or self._q is None:
+                return
+            self._q = None
+            if self.done:
+                return
+            self.detached_at = time.monotonic()
+            _tm_detached_runs.inc()
+            if self.resumable and self.linger > 0:
+                t = threading.Timer(self.linger, self._linger_expired)
+                t.daemon = True
+                self._linger_timer = t
+                t.start()
+                return
+            ctx = self.ctx
+        if ctx is not None:
+            ctx.cancel()
+
+    def _linger_expired(self) -> None:
+        with self._mu:
+            if self._q is not None or self.done:
+                return
+            # cancel UNDER the lock: a resume attaching right now holds
+            # the same lock in attach(), so it either lands before this
+            # check (we return) or after the cancel (and sees the run
+            # wind down with its trailer) — never a cancelled-under-
+            # the-client limbo
+            if self.ctx is not None:
+                self.ctx.cancel()
+        log.info("run %s (%s): no resume within %.1fs linger, cancelling",
+                 self.run_id, self.gadget, self.linger)
+
+    def finish(self) -> None:
+        """The run ended: wake the attached client with the end-of-stream
+        sentinel (never blocking — a gone client must not leak the run
+        thread)."""
+        with self._mu:
+            self.done = True
+            if self._linger_timer is not None:
+                self._linger_timer.cancel()
+                self._linger_timer = None
+            if self.detached_at is not None:
+                _tm_detached_runs.dec()
+                self.detached_at = None
+            q = self._q
+            if q is None:
+                return
+            while True:
+                try:
+                    q.put_nowait(None)
+                    return
+                except queue.Full:
+                    try:
+                        q.get_nowait()
+                        self.dropped += 1
+                    except queue.Empty:
+                        pass
 
 
 class AgentServer:
@@ -84,6 +274,10 @@ class AgentServer:
         self.node_name = node_name
         self.runtime = LocalRuntime(node_name=node_name)
         self._runs: dict[str, GadgetContext] = {}
+        # run_id → RunStream: the resume plane's registry. Entries retire
+        # a linger-window after the run ends so a client that dropped
+        # right before completion can still re-attach for the tail.
+        self._streams: dict[str, RunStream] = {}
         self._runs_mu = threading.Lock()
         # legacy CRD-path serving (ref: main.go:262-299 starts the Trace
         # controller inside the node daemon)
@@ -143,8 +337,92 @@ class AgentServer:
         with TRACER.span("agent/RunGadget", parent=wire.extract_span(header),
                          attrs={"node": self.node_name},
                          ambient=False) as rpc_span:
-            yield from self._run_gadget_traced(header, rpc_span,
+            if header.get("resume"):
+                yield from self._resume_stream(header["resume"],
                                                request_iterator, context)
+            else:
+                yield from self._run_gadget_traced(header, rpc_span,
+                                                   request_iterator, context)
+
+    def _resume_stream(self, resume: dict, request_iterator,
+                       context) -> Iterator[bytes]:
+        """Re-attach a reconnecting client to a still-running (or just-
+        finished, still-lingering) gadget run: replay everything after
+        last_seq from the ring, then continue live — capture never
+        restarted. An unknown run_id (this agent was respawned, or the
+        linger expired) answers with `unknown_run` so the client knows
+        to restart fresh and heal the gap from sealed windows instead."""
+        run_id = str(resume.get("run_id") or "")
+        last_seq = int(resume.get("last_seq") or 0)
+        with self._runs_mu:
+            state = self._streams.get(run_id)
+        if state is None:
+            yield wire.encode_msg(
+                {"error": f"unknown run {run_id!r} on {self.node_name}: "
+                          f"nothing to resume",
+                 "unknown_run": True, "node": self.node_name})
+            return
+        q, gen, ack = state.attach(last_seq)
+        _tm_stream_resumes.labels(gadget=state.gadget).inc()
+        log.info("run %s (%s): client re-attached at seq %d "
+                 "(replayed %d, missed %d)", run_id, state.gadget,
+                 last_seq, ack["replayed"], ack["missed"])
+        yield wire.encode_msg({"type": wire.EV_RESUME_ACK,
+                               "node": self.node_name, "resume": ack})
+        threading.Thread(target=self._control_loop,
+                         args=(request_iterator, state.ctx, state),
+                         daemon=True).start()
+        try:
+            yield from self._serve_attached(state, q, gen, context)
+        finally:
+            state.detach(gen)
+
+    @staticmethod
+    def _control_loop(request_iterator, ctx, state) -> None:
+        """Client stop requests cancel the run. Transport death is NOT a
+        stop for resumable runs — the serving loop's detach starts the
+        linger window instead; non-resumable runs keep the original
+        cancel-on-disconnect contract."""
+        try:
+            for msg in request_iterator:
+                h, _ = wire.decode_msg(msg)
+                if h.get("stop"):
+                    if ctx is not None:
+                        ctx.cancel()
+                    return
+        except Exception:  # noqa: BLE001 — iterator died with the client
+            if (state is None or not state.resumable) and ctx is not None:
+                ctx.cancel()
+
+    def _serve_attached(self, state: RunStream, q: queue.Queue, gen: int,
+                        context) -> Iterator[bytes]:
+        """Pump one attachment's queue onto the wire until end-of-run,
+        client death, or takeover by a newer resume attachment."""
+        while True:
+            try:
+                item = q.get(timeout=0.25)
+            except queue.Empty:
+                if not context.is_active():
+                    return
+                if not state.owns(gen):
+                    return  # a newer resume took the stream over
+                continue
+            if item is None:
+                return
+            yield item
+            if not context.is_active():
+                return
+
+    def _retire_stream(self, state: RunStream, after: float) -> None:
+        def retire():
+            with self._runs_mu:
+                # identity-guarded: an unknown-run restart may have
+                # re-registered the same run_id with a NEW stream state
+                if self._streams.get(state.run_id) is state:
+                    self._streams.pop(state.run_id, None)
+        t = threading.Timer(max(after, 0.5), retire)
+        t.daemon = True
+        t.start()
 
     def _run_gadget_traced(self, header: dict, rpc_span, request_iterator,
                            context) -> Iterator[bytes]:
@@ -187,66 +465,108 @@ class AgentServer:
         run_logger = logging.Logger(f"ig-tpu.{desc.full_name}.{ctx.run_id}")
         run_logger.parent = logging.getLogger(f"ig-tpu.{desc.full_name}")
         ctx.logger = run_logger
+        # resume plane: the client opts in per run; the stream state
+        # below outlives this RPC so a reconnect can re-attach
+        state = RunStream(
+            ctx.run_id, desc.full_name,
+            resumable=bool(run.get("resumable")),
+            linger=float(run.get("linger") or RESUME_LINGER),
+            ring_size=int(run.get("ring") or RESUME_RING))
+        state.ctx = ctx
         with self._runs_mu:
+            prev = self._streams.get(ctx.run_id)
             self._runs[ctx.run_id] = ctx
+            self._streams[ctx.run_id] = state
+        if prev is not None and not prev.done and prev.ctx is not None:
+            # a client restarting under a reused run_id while the
+            # previous life still lingers: two gadgets capturing under
+            # one id would double-count — the new request supersedes
+            log.warning("run %s (%s): superseded by a new run request; "
+                        "cancelling the previous life",
+                        ctx.run_id, desc.full_name)
+            prev.ctx.cancel()
         _tm_active_runs.inc()
         # server span per run (child of the RPC span); operators and the
         # device plane parent their spans to this via ctx.extra —
-        # ambient=False for the same cross-thread-generator reason
+        # ambient=False for the same cross-thread-generator reason.
+        # The run span, registries, and log handler are unwound by the
+        # RUN thread when the gadget actually ends — NOT when this RPC's
+        # generator dies, because a resumable run outlives its first
+        # connection by design.
         run_span = TRACER.span(f"agent/run/{desc.full_name}",
                                parent=rpc_span.context,
                                attrs={"run_id": ctx.run_id,
                                       "gadget": desc.full_name},
                                ambient=False)
-        try:
-            with run_span:
-                ctx.extra["trace_ctx"] = run_span.context
-                yield from self._run_gadget_stream(ctx, desc, outputs,
-                                                   request_iterator, context)
-        finally:
-            # also reached via GeneratorExit when the client cancels the
-            # stream mid-run: the run must be cancelled and accounting
-            # unwound, or _runs and the active-runs gauge drift upward
-            ctx.cancel()
-            handler = ctx.extra.pop("_stream_log_handler", None)
-            if handler is not None:
-                ctx.logger.removeHandler(handler)
-            with self._runs_mu:
-                self._runs.pop(ctx.run_id, None)
-            _tm_active_runs.dec()
+        yield from self._run_gadget_stream(ctx, desc, outputs, state,
+                                           run_span, request_iterator,
+                                           context)
 
-    def _run_gadget_stream(self, ctx, desc, outputs, request_iterator,
+    def _run_gadget_stream(self, ctx, desc, outputs, state: RunStream,
+                           run_span, request_iterator,
                            context) -> Iterator[bytes]:
-        out_q: queue.Queue = queue.Queue(maxsize=EVENT_BUFFER)
-        dropped = [0]
-        seq = [0]
-        m_msgs = _tm_stream_msgs.labels(gadget=desc.full_name)
-        m_dropped = _tm_stream_dropped.labels(gadget=desc.full_name)
-        m_qdepth = _tm_stream_q.labels(gadget=desc.full_name)
+        cleanup_mu = threading.Lock()
+        cleanup_state = {"done": False, "handler": None}
 
-        def push(kind: int, header: dict, payload: bytes = b""):
-            seq[0] += 1
-            header = {**header, "seq": seq[0], "type": kind}
-            try:
-                out_q.put_nowait(wire.encode_msg(header, payload))
-                m_msgs.inc()
-                m_qdepth.set(out_q.qsize())
-            except queue.Full:
-                dropped[0] += 1  # ref: service.go:160-167 drop-on-full
-                m_dropped.inc()
+        def run_cleanup():
+            """Unwound exactly ONCE when the RUN ends (run thread,
+            loud-failure path, or a setup crash) — never on a mere
+            client disconnect: a resumable run outlives its first
+            connection by design."""
+            with cleanup_mu:
+                if cleanup_state["done"]:
+                    return
+                cleanup_state["done"] = True
+            ctx.cancel()
+            if cleanup_state["handler"] is not None:
+                ctx.logger.removeHandler(cleanup_state["handler"])
+            with self._runs_mu:
+                # identity-guarded: a superseding run request may have
+                # re-registered this run_id with a NEW context/stream
+                if self._runs.get(ctx.run_id) is ctx:
+                    self._runs.pop(ctx.run_id, None)
+            _tm_active_runs.dec()
+            run_span.__exit__(None, None, None)
+            # keep the stream state around one linger window so a client
+            # that dropped right before the end can resume for the tail
+            self._retire_stream(state, state.linger)
+
+        try:
+            yield from self._run_stream_setup_and_serve(
+                ctx, desc, outputs, state, run_span, run_cleanup,
+                cleanup_state, request_iterator, context)
+        except GeneratorExit:
+            # client disconnect mid-serve: the serving finally already
+            # detached; the run itself lives on (or cancels via detach
+            # for non-resumable runs) — no registry unwind here
+            raise
+        except BaseException:
+            # setup (or serving) died before the run thread could take
+            # ownership of cleanup: unwind so _runs/_streams and the
+            # active-runs gauge cannot drift in a long-lived agent
+            run_cleanup()
+            state.finish()
+            raise
+
+    def _run_stream_setup_and_serve(self, ctx, desc, outputs,
+                                    state: RunStream, run_span,
+                                    run_cleanup, cleanup_state,
+                                    request_iterator,
+                                    context) -> Iterator[bytes]:
+        push = state.push
 
         # run logs multiplex onto the same stream with severity in the
         # type bits; run/trace IDs ride the header so the client can
         # correlate a remote log line with this run's spans
+        run_span.__enter__()
+        ctx.extra["trace_ctx"] = run_span.context
         trace_ctx = ctx.extra.get("trace_ctx")
         stream_log = StreamLogger(
             push, shift=wire.EV_LOG_SHIFT, run_id=ctx.run_id,
             trace_id=trace_ctx.trace_id if trace_ctx is not None else "")
         log_handler = StreamLogHandler(stream_log)
         ctx.logger.addHandler(log_handler)
-        # detached by the caller's finally: the stream can end via client
-        # cancel (GeneratorExit) anywhere in the loop below
-        ctx.extra["_stream_log_handler"] = log_handler
+        cleanup_state["handler"] = log_handler
 
         cols = desc.columns()
 
@@ -286,17 +606,9 @@ class AgentServer:
         ctx.extra["on_alert_event"] = on_alert_event
 
         # control reader: client stop requests cancel the context
-        def control_loop():
-            try:
-                for msg in request_iterator:
-                    h, _ = wire.decode_msg(msg)
-                    if h.get("stop"):
-                        ctx.cancel()
-                        return
-            except Exception:
-                ctx.cancel()
-
-        threading.Thread(target=control_loop, daemon=True).start()
+        threading.Thread(target=self._control_loop,
+                         args=(request_iterator, ctx, state),
+                         daemon=True).start()
 
         # resolve handler wiring BEFORE spawning the run thread so an
         # unknown gadget type fails the RPC loudly instead of vanishing
@@ -306,10 +618,20 @@ class AgentServer:
                                             on_event, on_event_array)
         except ValueError as e:
             log.error("RunGadget %s: %s", desc.full_name, e)
-            yield wire.encode_msg({"type": wire.EV_RESULT, "error": str(e)})
+            # the error trailer goes through the ring like every other
+            # trailer: a client that loses this connection and resumes
+            # within the retire window must still see the failure, not
+            # a clean empty end
+            push(wire.EV_RESULT, {"error": str(e), "gadget_error": True},
+                 force=True)
+            run_cleanup()
+            state.finish()
+            q, gen, _ack = state.attach(0)
+            try:
+                yield from self._serve_attached(state, q, gen, context)
+            finally:
+                state.detach(gen)
             return
-
-        result_holder = {}
 
         def run_thread():
             try:
@@ -319,44 +641,32 @@ class AgentServer:
                     on_event_array=h_array,
                     on_batch=on_batch,
                 )
-                result_holder["result"] = res
+                # trailers ride the same seq'd push path (force=True so a
+                # full queue evicts data, never the result) — they live
+                # in the ring too, so a resumed client still gets them
+                node_res = res.get(self.node_name) if res else None
+                if node_res is not None and node_res.error:
+                    push(wire.EV_RESULT, {"error": node_res.error,
+                                          "gadget_error": True}, force=True)
+                elif node_res is not None and isinstance(node_res.result,
+                                                         bytes):
+                    push(wire.EV_RESULT, {}, node_res.result, force=True)
+                if state.dropped:
+                    push(wire.EV_CONTROL_ACK, {"dropped": state.dropped},
+                         force=True)
             finally:
-                # sentinel must never block: a full queue with a gone client
-                # would leak this thread — make room, then mark end-of-stream
-                while True:
-                    try:
-                        out_q.put_nowait(None)
-                        break
-                    except queue.Full:
-                        try:
-                            out_q.get_nowait()
-                            dropped[0] += 1
-                        except queue.Empty:
-                            pass
+                run_cleanup()
+                # end-of-stream sentinel; never blocks on a gone client
+                state.finish()
 
         t = threading.Thread(target=run_thread, daemon=True)
         t.start()
 
-        while True:
-            item = out_q.get()
-            if item is None:
-                break
-            yield item
-            if not context.is_active():
-                ctx.cancel()
-                break
-
-        t.join(timeout=5.0)
-        res = result_holder.get("result")
-        if res is not None:
-            node_res = res.get(self.node_name)
-            if node_res is not None and node_res.error:
-                yield wire.encode_msg({"type": wire.EV_RESULT, "error": node_res.error})
-            elif node_res is not None and isinstance(node_res.result, bytes):
-                yield wire.encode_msg({"type": wire.EV_RESULT}, node_res.result)
-        if dropped[0]:
-            yield wire.encode_msg({"type": wire.EV_CONTROL_ACK,
-                                   "dropped": dropped[0]})
+        q, gen, _ack = state.attach(0)
+        try:
+            yield from self._serve_attached(state, q, gen, context)
+        finally:
+            state.detach(gen)
 
     # -- ContainerManager (hook-facing; ref: gadgettracermanager.go:151) ----
 
@@ -631,6 +941,19 @@ class AgentServer:
             frames[str(tid)] = stack
         with self._runs_mu:
             runs = list(self._runs)
+            stream_states = list(self._streams.values())
+        # resume-plane view: every live (or lingering) run stream with
+        # its attach state — `ig-tpu fleet health` reads this to tell a
+        # serving run from one awaiting a resume
+        now = time.monotonic()
+        run_rows = [{
+            "run_id": st.run_id, "gadget": st.gadget, "seq": st.seq,
+            "resumable": st.resumable, "attached": st.is_attached(),
+            "attaches": st.attaches, "done": st.done,
+            "dropped": st.dropped,
+            "detached_for": (round(now - st.detached_at, 3)
+                             if st.detached_at is not None else 0.0),
+        } for st in stream_states]
         # container set, as the reference's DumpState does
         # (gadgettracermanager.go:204-219 dumps containers + stacks)
         containers: list = []
@@ -651,6 +974,7 @@ class AgentServer:
         # `ig-tpu alerts list` can read every agent's active alerts
         from ..alerts import ACTIVE as active_alerts
         msg = {"threads": frames, "active_runs": runs,
+               "runs": run_rows,
                "containers": containers,
                "alerts": active_alerts.all(),
                # CRD-path state rides the same debug dump (the reference's

@@ -28,6 +28,8 @@ EV_CONTROL_ACK = 6
 EV_ALERT = 7            # alert lifecycle transition (alerts/engine.py)
 EV_JOURNAL_MARK = 8     # capture-journal lifecycle marker (capture/)
 EV_WINDOW = 9           # sealed sketch window (history/) — mergeable state
+EV_RESUME_ACK = 10      # resume re-attach acknowledgment (carries the
+                        # replay start + how many seqs the ring lost)
 EV_LOG_SHIFT = 16       # type >> 16 = severity when nonzero
 
 # The one registry every EV_* wire id must appear in. Stream decoding,
@@ -46,6 +48,7 @@ WIRE_EVENT_IDS: dict[str, int] = {
     "EV_ALERT": EV_ALERT,
     "EV_JOURNAL_MARK": EV_JOURNAL_MARK,
     "EV_WINDOW": EV_WINDOW,
+    "EV_RESUME_ACK": EV_RESUME_ACK,
 }
 
 

@@ -1,0 +1,92 @@
+"""`ig-tpu fleet` — fleet-plane verbs.
+
+`fleet health` probes every agent with a bounded per-RPC deadline and
+renders the reachability + run-stream view the chaos runtime maintains
+live: a reachable agent is `healthy`, an unreachable one `dead`, and
+each agent's DumpState `runs` rows show which gadget runs are serving a
+client vs lingering detached awaiting a resume. This is the operator's
+"is the fleet fine?" surface; the *in-run* states
+(healthy|reconnecting|straggling|dead) ride CombinedGadgetResult and the
+`ig_fleet_node_state` gauge of the process running the fan-out.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def add_fleet_parser(sub) -> None:
+    fp = sub.add_parser(
+        "fleet", help="fleet-plane verbs: per-agent health, run-stream "
+        "attach states, reconnect/backfill counters")
+    fsub = fp.add_subparsers(dest="fleet_verb", required=True)
+    hp = fsub.add_parser(
+        "health", help="probe every agent under a bounded deadline; "
+        "report healthy/dead + active and lingering runs")
+    hp.add_argument("--remote", default="",
+                    help="name=target[,...]; defaults to the local fleet")
+    hp.add_argument("--deadline", type=float, default=3.0,
+                    help="per-agent RPC deadline in seconds (an "
+                         "unresponsive agent is reported dead, not "
+                         "waited on)")
+    hp.add_argument("-o", "--output", default="table",
+                    choices=["table", "json"])
+    hp.set_defaults(func=cmd_fleet_health)
+
+
+def _probe_agent(node: str, target: str, deadline: float) -> dict:
+    from ..agent.client import AgentClient
+    row: dict = {"node": node, "target": target, "state": "healthy",
+                 "runs": [], "detached": 0, "alerts": 0, "error": ""}
+    client = None
+    try:
+        client = AgentClient(target, node, rpc_deadline=deadline)
+        state = client.dump_state()
+        runs = state.get("runs") or []
+        row["runs"] = runs
+        row["detached"] = sum(1 for r in runs
+                              if not r.get("attached") and not r.get("done"))
+        row["alerts"] = len(state.get("alerts") or [])
+    except Exception as e:  # noqa: BLE001 — per-node isolation
+        row["state"] = "dead"
+        row["error"] = str(e)
+    finally:
+        if client is not None:
+            client.close()
+    return row
+
+
+def cmd_fleet_health(args) -> int:
+    from ..params import ParamError
+    from .main import parse_targets
+    try:
+        if args.remote:
+            targets = parse_targets(args.remote)
+        else:
+            from .deploy import local_targets
+            targets = local_targets()
+    except ParamError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not targets:
+        print("no agents (use deploy --local N or --remote)",
+              file=sys.stderr)
+        return 2
+    rows = [_probe_agent(n, t, args.deadline) for n, t in targets.items()]
+    if args.output == "json":
+        print(json.dumps({"agents": rows}, indent=2, default=str))
+    else:
+        print(f"{'NODE':<14s} {'STATE':<9s} {'RUNS':>4s} {'DETACHED':>8s} "
+              f"{'ALERTS':>6s}  DETAIL")
+        for r in rows:
+            active = sum(1 for run in r["runs"] if not run.get("done"))
+            detail = r["error"]
+            if not detail and r["detached"]:
+                lingering = [run["run_id"] for run in r["runs"]
+                             if not run.get("attached")
+                             and not run.get("done")]
+                detail = ("awaiting resume: " + ", ".join(lingering))
+            print(f"{r['node']:<14s} {r['state']:<9s} {active:>4d} "
+                  f"{r['detached']:>8d} {r['alerts']:>6d}  {detail}")
+    return 0 if all(r["state"] == "healthy" for r in rows) else 1

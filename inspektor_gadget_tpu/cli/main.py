@@ -148,6 +148,10 @@ def build_parser() -> argparse.ArgumentParser:
     from .query import add_query_parser
     add_query_parser(sub)
 
+    # fleet robustness plane: per-agent health + run-stream attach states
+    from .fleet import add_fleet_parser
+    add_fleet_parser(sub)
+
     vp = sub.add_parser("version", help="print version")
     vp.set_defaults(func=lambda a: (print(_version()), 0)[1])
 
@@ -684,6 +688,17 @@ def cmd_run(args) -> int:
         else None,
         **run_kwargs,
     )
+    if getattr(result, "partial", False) and result.contributing():
+        # a degraded fleet answer is LABELED partial, never silently
+        # full-looking (supervisor.FleetHealth states ride the result).
+        # Zero contributors is not a partial answer — it is a plain
+        # failure, and the per-node error lines below cover it.
+        unhealthy = {n: s for n, s in result.health.items()
+                     if s != "healthy"}
+        print("warning: PARTIAL result — contributing: "
+              + (",".join(result.contributing()) or "<none>")
+              + (f"; unhealthy: {unhealthy}" if unhealthy else ""),
+              file=sys.stderr)
     errs = result.errors()
     if errs:
         for node, err in errs.items():

@@ -352,6 +352,51 @@ def _probe_history_dir() -> Window:
         return Window("history_dir", False, repr(e))
 
 
+def _probe_fleet_health() -> Window:
+    """Fleet-plane row: are the locally-registered agents (deploy
+    --local) reachable under a bounded deadline? No local fleet is fine
+    — single-node mode — but a registered agent that doesn't answer is
+    exactly the kind of silent rot the chaos runtime exists to surface
+    (`ig-tpu fleet health` gives the per-run detail)."""
+    try:
+        from .cli.deploy import local_targets
+        targets = local_targets()
+        if not targets:
+            return Window("fleet_health", True,
+                          "no local fleet registered (single-node mode)")
+        from concurrent.futures import ThreadPoolExecutor
+
+        from .agent.client import AgentClient
+
+        def probe(item):
+            node, target = item
+            client = None
+            try:
+                client = AgentClient(target, node, rpc_deadline=2.0)
+                client.get_catalog(use_cache_on_error=False)
+                return None
+            except Exception:  # noqa: BLE001 — unreachable is the finding
+                return node
+            finally:
+                if client is not None:
+                    client.close()
+
+        # concurrent probes: the row costs one deadline, not one per
+        # agent — a large registered fleet must not scale doctor latency
+        with ThreadPoolExecutor(max_workers=min(len(targets), 16)) as ex:
+            down = [n for n in ex.map(probe, targets.items())
+                    if n is not None]
+        if down:
+            return Window("fleet_health", False,
+                          f"{len(down)}/{len(targets)} agent(s) "
+                          f"unreachable: {', '.join(sorted(down))} "
+                          f"(expected during fleet bring-up)")
+        return Window("fleet_health", True,
+                      f"{len(targets)} local agent(s) reachable")
+    except Exception as e:  # noqa: BLE001
+        return Window("fleet_health", False, repr(e))
+
+
 def _probe_mountinfo() -> Window:
     try:
         with open("/proc/self/mountinfo") as f:
@@ -378,7 +423,7 @@ _PROBES = (
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
     _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,
     _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
-    _probe_history_dir,
+    _probe_history_dir, _probe_fleet_health,
 )
 
 

@@ -20,13 +20,15 @@ from typing import Any, Callable
 
 from ..gadgets.context import GadgetContext
 from ..gadgets.interface import GadgetType
-from ..params import ParamDesc, ParamDescs
+from ..params import ParamDesc, ParamDescs, TypeHint, parse_duration
 from ..snapshotcombiner import SnapshotCombiner
 from ..telemetry import counter, gauge
 from ..telemetry.tracing import TRACER
 from .runtime import CombinedGadgetResult, GadgetResult, Runtime
+from .supervisor import FleetHealth, NodeSupervisor, RetryPolicy, classify_error
 
-STOP_RESULT_TIMEOUT = 30.0  # ref: grpc-runtime.go:347-353
+STOP_RESULT_TIMEOUT = 30.0  # default; ref: grpc-runtime.go:347-353
+                            # (runtime param stop-result-timeout overrides)
 
 # fan-out telemetry: message-grain per node (a message carries a row array
 # or batch); lag is read at SCRAPE time as the age of the node's last
@@ -36,13 +38,22 @@ STOP_RESULT_TIMEOUT = 30.0  # ref: grpc-runtime.go:347-353
 _tm_node_events = counter("ig_runtime_node_events_total",
                           "rows received from each node's stream", ("node",))
 _tm_node_errors = counter("ig_runtime_node_errors_total",
-                          "per-node gadget-run errors", ("node",))
-_tm_node_gaps = counter("ig_runtime_node_gaps_total",
-                        "events lost in transit per node (seq gaps)",
-                        ("node",))
+                          "per-node gadget-run errors by class "
+                          "(transport = flaky network, retried with "
+                          "resume; fatal = broken gadget, not retried)",
+                          ("node", "class"))
+_tm_seq_gaps = counter("ig_runtime_seq_gaps_total",
+                       "stream messages lost in transit per node "
+                       "(client-observed seq gaps, incl. resume-ring "
+                       "overflow during outages)", ("node",))
 _tm_node_lag = gauge("ig_runtime_node_stream_lag_seconds",
                      "seconds since each node's last stream message "
                      "(grows while a node is stalled)", ("node",))
+
+
+def _validate_positive_duration(value: str) -> None:
+    if parse_duration(value) <= 0:
+        raise ValueError(f"duration {value!r} must be > 0")
 
 
 class GrpcRuntime(Runtime):
@@ -57,10 +68,77 @@ class GrpcRuntime(Runtime):
         self._clients: dict[str, Any] = {}
 
     def params(self) -> ParamDescs:
+        from ..params.validators import validate_int_range
         return ParamDescs([
             ParamDesc(key="node", default="",
                       description="restrict to one node"),
+            ParamDesc(key="stop-result-timeout",
+                      default=f"{STOP_RESULT_TIMEOUT:g}s",
+                      type_hint=TypeHint.DURATION,
+                      validator=_validate_positive_duration,
+                      description="how long to wait for node results "
+                                  "after the stop fan-out (ref: "
+                                  "grpc-runtime.go:347-353)"),
+            ParamDesc(key="supervise", default="true",
+                      type_hint=TypeHint.BOOL,
+                      description="supervise node streams: reconnect "
+                                  "with resume on transport errors "
+                                  "instead of abandoning the node"),
+            ParamDesc(key="retry-base", default="200ms",
+                      type_hint=TypeHint.DURATION,
+                      validator=_validate_positive_duration,
+                      description="reconnect backoff base (full-jitter "
+                                  "exponential)"),
+            ParamDesc(key="retry-cap", default="3s",
+                      type_hint=TypeHint.DURATION,
+                      validator=_validate_positive_duration,
+                      description="reconnect backoff ceiling"),
+            ParamDesc(key="retry-horizon", default="30s",
+                      type_hint=TypeHint.DURATION,
+                      validator=_validate_positive_duration,
+                      description="outage length after which a node is "
+                                  "labeled dead (retries continue at the "
+                                  "capped rate; a later heal resurrects "
+                                  "it)"),
+            ParamDesc(key="attempt-deadline", default="5s",
+                      type_hint=TypeHint.DURATION,
+                      validator=_validate_positive_duration,
+                      description="per-attempt connect deadline while "
+                                  "reconnecting"),
+            ParamDesc(key="resume-linger", default="10s",
+                      type_hint=TypeHint.DURATION,
+                      validator=_validate_positive_duration,
+                      description="how long the agent keeps a "
+                                  "disconnected run alive awaiting a "
+                                  "resume"),
+            ParamDesc(key="resume-ring", default="1024",
+                      type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=1),
+                      description="outbound messages the agent retains "
+                                  "for seq replay on resume"),
+            ParamDesc(key="straggler-factor", default="4.0",
+                      type_hint=TypeHint.FLOAT,
+                      description="a node silent for more than this × "
+                                  "the fleet's rolling inter-record p95 "
+                                  "is marked straggling"),
+            ParamDesc(key="straggler-floor", default="1s",
+                      type_hint=TypeHint.DURATION,
+                      validator=_validate_positive_duration,
+                      description="minimum straggler silence threshold "
+                                  "(no flapping on µs cadences)"),
+            ParamDesc(key="backfill", default="true",
+                      type_hint=TypeHint.BOOL,
+                      description="heal seq gaps from the node's sealed "
+                                  "history windows after an outage"),
         ])
+
+    def _rp(self, ctx: GadgetContext, key: str):
+        """Runtime param with default fallback: contexts built without
+        this runtime's params (tests, older callers) get the documented
+        defaults instead of KeyErrors."""
+        if key in ctx.runtime_params:
+            return ctx.runtime_params.get(key)
+        return self.params().get(key).to_param()
 
     def _client(self, node: str):
         from ..agent.client import AgentClient
@@ -253,6 +331,25 @@ class GrpcRuntime(Runtime):
         results_mu = threading.Lock()
         stop_event = threading.Event()
 
+        # supervision knobs (runtime params with documented defaults)
+        supervise = self._rp(ctx, "supervise").as_bool()
+        policy = RetryPolicy(
+            base=self._rp(ctx, "retry-base").as_duration(),
+            cap=max(self._rp(ctx, "retry-cap").as_duration(),
+                    self._rp(ctx, "retry-base").as_duration()),
+            horizon=self._rp(ctx, "retry-horizon").as_duration(),
+            attempt_deadline=self._rp(ctx, "attempt-deadline").as_duration())
+        resume_linger = self._rp(ctx, "resume-linger").as_duration()
+        resume_ring = self._rp(ctx, "resume-ring").as_int()
+        backfill = self._rp(ctx, "backfill").as_bool()
+        stop_timeout = self._rp(ctx, "stop-result-timeout").as_duration()
+        health = FleetHealth(
+            nodes,
+            straggler_factor=self._rp(ctx, "straggler-factor").as_float(),
+            straggler_floor=self._rp(ctx, "straggler-floor").as_duration(),
+        )
+        ctx.extra["fleet_health"] = health  # live view for embedders
+
         last_msg = {n: time.monotonic() for n in nodes}
         for n in nodes:
             # scrape-time age: keeps growing while the node is silent
@@ -309,13 +406,21 @@ class GrpcRuntime(Runtime):
 
         def run_node(node: str):
             # one child span per node stream; its context rides the run
-            # request so the agent's server spans parent to it
+            # request so the agent's server spans parent to it. The
+            # supervisor owns reconnect/resume around the raw stream
+            # call; per-node isolation (runtime.go:42-79) is the outer
+            # except.
             with TRACER.span(f"client/node/{node}",
                              parent=root_span.context,
                              attrs={"node": node}) as nsp:
                 client = self._client(node)
-                try:
-                    res = client.run_gadget(
+                run_id = f"{ctx.run_id}-{node}"
+
+                def on_msg(_n: str, _seq: int, _t: int, node=node):
+                    health.observe(node)
+
+                def attempt(resume_from, rid, node=node, nsp=nsp):
+                    return client.run_gadget(
                         ctx.desc.category, ctx.desc.name, flat,
                         timeout=ctx.timeout, outputs=tuple(outputs),
                         on_json=on_json, on_array=on_array,
@@ -323,24 +428,65 @@ class GrpcRuntime(Runtime):
                         on_summary=on_summary,
                         on_alert=on_node_alert,
                         on_log=on_remote_log,
+                        on_message=on_msg,
                         stop_event=stop_event,
                         trace_ctx=nsp.context,
+                        run_id=rid,
+                        resumable=supervise,
+                        linger=resume_linger,
+                        ring=resume_ring,
+                        resume_from=resume_from,
                     )
+
+                sup = NodeSupervisor(
+                    node, client, policy=policy, health=health,
+                    run_id=run_id, gadget=ctx.desc.full_name,
+                    done=lambda: ctx.done or stop_event.is_set(),
+                    logger=ctx.logger, backfill=backfill)
+                try:
+                    if supervise:
+                        out = sup.run(attempt)
+                    else:
+                        out = attempt(None, run_id)
+                        if out.get("error"):
+                            health.mark(node, "dead")
                     with results_mu:
-                        results[node] = GadgetResult(result=res.get("result"),
-                                                     error=res.get("error"))
-                        if res.get("error"):
-                            _tm_node_errors.labels(node=node).inc()
-                        if res.get("gaps"):
-                            _tm_node_gaps.labels(node=node).inc(res["gaps"])
-                            ctx.logger.warning("[%s] %d events lost in transit",
-                                               node, res["gaps"])
+                        results[node] = GadgetResult(
+                            result=out.get("result"),
+                            error=out.get("error"),
+                            gaps=int(out.get("gaps") or 0),
+                            reconnects=int(out.get("reconnects") or 0),
+                            records=int(out.get("records") or 0),
+                            last_seq=int(out.get("last_seq") or 0),
+                            backfilled=int(out.get("backfilled") or 0),
+                            backfill=list(out.get("backfill") or ()),
+                            health=health.get(node))
+                        if out.get("error"):
+                            _tm_node_errors.labels(
+                                node=node,
+                                **{"class": classify_error(
+                                    out["error"],
+                                    gadget_error=bool(
+                                        out.get("gadget_error")))}).inc()
+                        if out.get("gaps"):
+                            _tm_seq_gaps.labels(node=node).inc(out["gaps"])
+                            ctx.logger.warning(
+                                "[%s] %d stream message(s) lost in transit "
+                                "(%d healed from sealed windows)",
+                                node, out["gaps"],
+                                int(out.get("backfilled") or 0))
                 except Exception as e:  # per-node isolation (runtime.go:42-79)
                     nsp.set_attr("error", str(e))
-                    _tm_node_errors.labels(node=node).inc()
+                    _tm_node_errors.labels(node=node, **{"class": "fatal"}).inc()
+                    health.mark(node, "dead")
                     with results_mu:
-                        results[node] = GadgetResult(error=str(e))
+                        results[node] = GadgetResult(error=str(e),
+                                                     health="dead")
                 finally:
+                    # this node's supervision is over: its final health
+                    # label is settled — the straggler monitor must not
+                    # re-flag its post-run silence
+                    health.finish(node)
                     # stream end reconciles this node's alerts: a dropped
                     # EV_ALERT 'resolved' (or a crashed node) must not
                     # wedge a cluster alert active forever
@@ -359,6 +505,26 @@ class GrpcRuntime(Runtime):
 
             threading.Thread(target=tick_loop, daemon=True).start()
 
+        # straggler monitor: a node silent for more than
+        # straggler-factor × the fleet's rolling inter-record p95 is
+        # flagged — slow relative to its PEERS, not to a wall-clock
+        # constant (the fleet defines normal cadence). It stops the
+        # moment the run starts winding down: silence during shutdown
+        # is expected, and flagging it would mislabel a complete
+        # answer as partial.
+        straggle_stop = threading.Event()
+
+        def straggle_loop():
+            while not straggle_stop.wait(0.25):
+                for flagged in health.check_stragglers():
+                    ctx.logger.warning(
+                        "[%s] straggling: silent for %.2fs (fleet p95 "
+                        "threshold %.2fs)", flagged,
+                        health.silence(flagged),
+                        health.straggler_threshold())
+
+        threading.Thread(target=straggle_loop, daemon=True).start()
+
         # all node streams finishing on their own (one-shot / run-with-result
         # gadgets) also ends the run — don't wait for a timeout that never fires
         def all_done_watch():
@@ -370,12 +536,37 @@ class GrpcRuntime(Runtime):
 
         # wait: context timeout/cancel then stop-fanout (ref: :336-353)
         ctx.wait_for_timeout_or_done()
+        straggle_stop.set()
         stop_event.set()
+        # ONE stop window shared by every node (not N× sequential joins:
+        # a wide partition at stop time must not scale the wait with
+        # fleet size)
+        join_deadline = time.monotonic() + stop_timeout
         for t in threads:
-            t.join(timeout=STOP_RESULT_TIMEOUT)
+            t.join(timeout=max(0.0, join_deadline - time.monotonic()))
         ticker_stop.set()
+        # a stream wedged past the stop window must yield a LABELED dead
+        # node, not a hang and not a silently missing key
+        with results_mu:
+            wedged = [n for n in nodes if n not in results]
+            for n in wedged:
+                results[n] = GadgetResult(
+                    error=f"node stream still wedged {stop_timeout:.0f}s "
+                          f"after stop fan-out", health="dead")
+        for n in wedged:
+            health.mark(n, "dead")
         if is_one_shot and on_event_array is not None:
             # flush even when empty so callers still see `[]` / a header,
             # matching the local path
             on_event_array(one_shot_rows)
+        # final fleet-health labels ride the combined result so a partial
+        # answer is LABELED partial (results.partial), never silently
+        # full-looking
+        results.health = health.states()
+        if results.partial:
+            degraded = {n: s for n, s in results.health.items()
+                        if s != "healthy"}
+            ctx.logger.warning(
+                "partial result: %d/%d node(s) contributed (unhealthy: %s)",
+                len(results.contributing()), len(nodes), degraded)
         return results

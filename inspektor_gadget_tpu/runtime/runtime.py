@@ -21,11 +21,29 @@ from ..params import ParamDescs, Params
 class GadgetResult:
     result: Any = None
     error: str | None = None
+    # stream accounting (filled by the supervised gRPC fan-out; the
+    # local runtime leaves the defaults): seq gaps observed in transit,
+    # reconnect attempts, records received, highest seq seen, events
+    # recovered from sealed-window backfill, and the sealed windows
+    # themselves so harvest merges can fold the healed state in.
+    gaps: int = 0
+    reconnects: int = 0
+    records: int = 0
+    last_seq: int = 0
+    backfilled: int = 0
+    backfill: list = dataclasses.field(default_factory=list)
+    health: str = ""
 
 
 class CombinedGadgetResult(dict):
     """node → GadgetResult; partial failures stay per-node
-    (ref: runtime.go:42-79)."""
+    (ref: runtime.go:42-79). `health` carries each node's final fleet
+    state (supervisor.FleetHealth) so a degraded answer is LABELED
+    degraded instead of silently looking whole."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.health: dict[str, str] = {}
 
     def first(self) -> Any:
         for r in self.values():
@@ -35,6 +53,19 @@ class CombinedGadgetResult(dict):
 
     def errors(self) -> dict[str, str]:
         return {k: r.error for k, r in self.items() if r.error}
+
+    def contributing(self) -> list[str]:
+        """Nodes whose stream ended cleanly — the ones a harvest merge
+        actually contains."""
+        return [k for k, r in self.items() if r.error is None]
+
+    @property
+    def partial(self) -> bool:
+        """True when any node failed or ended unhealthy: the merged
+        answer does not cover the whole fleet."""
+        if any(r.error for r in self.values()):
+            return True
+        return any(s not in ("", "healthy") for s in self.health.values())
 
 
 class Runtime:

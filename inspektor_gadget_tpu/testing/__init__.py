@@ -8,6 +8,7 @@ order with start-and-stop semantics, guaranteed cleanup, and declarative
 output matching against normalized JSON events.
 """
 
+from .chaos import AgentProcess, ChaosProxy, SkewClock
 from .steps import Command, FuncStep, TestStep, run_test_steps
 from .match import (
     build_common_data,
@@ -19,6 +20,9 @@ from .match import (
 )
 
 __all__ = [
+    "AgentProcess",
+    "ChaosProxy",
+    "SkewClock",
     "Command",
     "FuncStep",
     "TestStep",

@@ -31,7 +31,8 @@ def test_probe_windows_names_and_shape():
                 "kmsg", "ptrace", "sock_diag", "netlink_proc", "af_packet",
                 "mountinfo", "procfs", "blktrace", "tcpinfo", "audit",
                 "captrace", "fstrace", "sockstate", "sigtrace",
-                "container_runtime", "capture_dir", "history_dir"}
+                "container_runtime", "capture_dir", "history_dir",
+                "fleet_health"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail
@@ -54,6 +55,25 @@ def test_history_dir_row_reports_writability_usage_and_free(monkeypatch,
         monkeypatch.setenv("IG_HISTORY_DIR", str(ro / "hist"))
         w = probe_windows()["history_dir"]
         assert not w.ok and "unwritable" in w.detail
+
+
+def test_fleet_health_row_reports_local_fleet(monkeypatch):
+    """The fleet-plane doctor row (ISSUE 11 satellite): no registered
+    local fleet is fine (single-node mode); a registered agent nobody
+    serves degrades the row with the unreachable node named."""
+    import inspektor_gadget_tpu.cli.deploy as deploy
+    from inspektor_gadget_tpu.doctor import _probe_fleet_health
+
+    monkeypatch.setattr(deploy, "local_targets", lambda: {})
+    w = _probe_fleet_health()
+    assert w.ok and "single-node" in w.detail
+
+    monkeypatch.setattr(deploy, "local_targets",
+                        lambda: {"ghost": "127.0.0.1:1"})
+    monkeypatch.setenv("IG_RPC_DEADLINE", "2.0")
+    w = _probe_fleet_health()
+    assert not w.ok
+    assert "unreachable" in w.detail and "ghost" in w.detail
 
 
 def test_gadget_report_covers_every_registered_gadget():
