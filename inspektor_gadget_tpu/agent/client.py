@@ -25,6 +25,41 @@ RESULT_TIMEOUT = 30.0
 
 CATALOG_CACHE = Path.home() / ".ig-tpu" / "catalog.json"
 
+# the shared subscriber-option vocabulary lives in wire.py (one home for
+# client, agent, and params layer): the client refuses a bad attach
+# BEFORE it goes on the wire, the agent refuses it again server-side —
+# loud both ways, silent nowhere
+DROP_POLICIES = wire.DROP_POLICIES
+PRIORITIES = wire.PRIORITIES
+TIERS = wire.TIERS
+
+
+def _validate_subscriber_opts(opts: dict) -> None:
+    """Raise ValueError on malformed subscriber options (the params
+    layer applies the same vocabulary to the runtime flags)."""
+    unknown = set(opts) - {"id", "priority", "drop_policy", "queue",
+                           "evict_after", "tier"}
+    if unknown:
+        raise ValueError(f"unknown subscriber option(s) {sorted(unknown)}")
+    if opts.get("drop_policy") is not None \
+            and opts["drop_policy"] not in DROP_POLICIES:
+        raise ValueError(f"drop_policy must be one of {DROP_POLICIES}, "
+                         f"got {opts['drop_policy']!r}")
+    if opts.get("priority") is not None \
+            and opts["priority"] not in PRIORITIES:
+        raise ValueError(f"priority must be one of {PRIORITIES}, "
+                         f"got {opts['priority']!r}")
+    if opts.get("tier") is not None and opts["tier"] not in TIERS:
+        raise ValueError(f"tier must be one of {TIERS}, "
+                         f"got {opts['tier']!r}")
+    if opts.get("queue") is not None and int(opts["queue"]) < 1:
+        raise ValueError(f"subscriber queue bound must be >= 1, "
+                         f"got {opts['queue']}")
+    if opts.get("evict_after") is not None \
+            and float(opts["evict_after"]) <= 0:
+        raise ValueError(f"evict_after must be > 0, "
+                         f"got {opts['evict_after']}")
+
 
 class AgentClient:
     def __init__(self, target: str, node_name: str = "", dialer=None,
@@ -105,6 +140,7 @@ class AgentClient:
         on_alert: Callable[[str, dict], None] | None = None,
         on_log: Callable[[str, int, str, dict], None] | None = None,
         on_message: Callable[[str, int, int], None] | None = None,
+        on_window: Callable[[str, dict], None] | None = None,
         stop_event: threading.Event | None = None,
         trace_ctx=None,
         run_id: str | None = None,
@@ -112,6 +148,13 @@ class AgentClient:
         linger: float | None = None,
         ring: int | None = None,
         resume_from: int | None = None,
+        share: bool = False,
+        keepalive: float | None = None,
+        max_subscribers: int | None = None,
+        sub_budget: int | None = None,
+        subscriber: dict | None = None,
+        attach_to: str | None = None,
+        sub_id: str | None = None,
     ) -> dict:
         """Blocking run; returns {'result': bytes|None, 'error': str|None,
         'gaps': int, 'dropped': int, 'records': int, 'last_seq': int,
@@ -128,18 +171,41 @@ class AgentClient:
         for replay; resume_from re-attaches to an existing run (run_id
         required) and receives messages after that seq — the agent
         answers with an EV_RESUME_ACK (surfaced as out['resume']) or
-        `unknown_run` when it has nothing to resume (it restarted)."""
+        `unknown_run` when it has nothing to resume (it restarted).
+
+        Shared runs: share=True makes the run a first-class shared
+        resource — the first request for a (gadget, params, outputs) key
+        starts the gadget, compatible requests attach as SUBSCRIBERS to
+        the same pipeline (out['attach'] carries the typed ack; a
+        refused admission surfaces out['attach_refused']). `subscriber`
+        ({id, priority, drop_policy, queue, evict_after, tier}) shapes
+        this consumer's delivery: a slow subscriber drops its OWN
+        records (EV_DROP_NOTICE → out['sub_drops']) and one stalled past
+        evict_after is EVICTED (out['evicted'] + labeled terminal
+        record) — never stalling the gadget or its peers. keepalive /
+        max_subscribers / sub_budget are run-level (first request wins).
+        attach_to joins an existing run by run_id WITHOUT a run request
+        (tier='summary' subscribers get harvest summaries, alerts, and
+        sealed-window announcements only — on_window receives the
+        announcements). resume with sub_id re-attaches one subscriber."""
         method = self.channel.stream_stream(
             "/igtpu.GadgetManager/RunGadget",
             request_serializer=wire.identity_serializer,
             response_deserializer=wire.identity_deserializer,
         )
         ctrl_q: queue.Queue = queue.Queue()
+        sub_opts = dict(subscriber or {})
+        if sub_opts:
+            _validate_subscriber_opts(sub_opts)
         if resume_from is not None:
             if not run_id:
                 raise ValueError("resume_from requires run_id")
-            first_msg = {"resume": {"run_id": run_id,
-                                    "last_seq": int(resume_from)}}
+            resume_msg = {"run_id": run_id, "last_seq": int(resume_from)}
+            if sub_id:
+                resume_msg["sub_id"] = sub_id
+            first_msg = {"resume": resume_msg}
+        elif attach_to is not None:
+            first_msg = {"attach": {**sub_opts, "run_id": attach_to}}
         else:
             run: dict = {
                 "category": category, "name": name, "params": params or {},
@@ -153,6 +219,16 @@ class AgentClient:
                     run["linger"] = float(linger)
                 if ring is not None:
                     run["ring"] = int(ring)
+            if share:
+                run["share"] = True
+            if keepalive is not None:
+                run["keepalive"] = float(keepalive)
+            if max_subscribers is not None:
+                run["max_subscribers"] = int(max_subscribers)
+            if sub_budget is not None:
+                run["sub_budget"] = int(sub_budget)
+            if sub_opts:
+                run["subscriber"] = sub_opts
             first_msg = {"run": run}
         ctrl_q.put(wire.encode_msg(wire.inject_span(first_msg, trace_ctx)))
 
@@ -172,7 +248,9 @@ class AgentClient:
 
         out = {"result": None, "error": None, "gaps": 0, "dropped": 0,
                "records": 0, "last_seq": int(resume_from or 0),
-               "resume": None, "unknown_run": False, "gadget_error": False}
+               "resume": None, "unknown_run": False, "gadget_error": False,
+               "attach": None, "attach_refused": "", "sub_drops": 0,
+               "drop_notices": 0, "evicted": False}
         # resuming: seq numbering continues from what we already hold, so
         # gap detection spans the outage — a replay ring that overflowed
         # shows up as a gap here (and as `missed` in the resume ack)
@@ -221,6 +299,33 @@ class AgentClient:
                     out["dropped"] = header.get("dropped", 0)
                 elif t == wire.EV_RESUME_ACK:
                     out["resume"] = header.get("resume", {})
+                elif t == wire.EV_ATTACH_ACK:
+                    a = header.get("attach", {})
+                    out["attach"] = a
+                    if a.get("refused"):
+                        # typed admission refusal: deterministic — the
+                        # supervisor must surface it, never retry it
+                        out["attach_refused"] = a.get("reason", "refused")
+                        out["error"] = header.get("error") or \
+                            f"attach refused ({out['attach_refused']})"
+                        out["gadget_error"] = True
+                elif t == wire.EV_DROP_NOTICE:
+                    # this subscriber's own overload accounting: its
+                    # bounded queue dropped records (policy/class in the
+                    # header); evicted=True is the labeled terminal
+                    # record of a stalled subscriber
+                    out["drop_notices"] += 1
+                    out["sub_drops"] = max(
+                        out["sub_drops"], int(header.get("drops_total", 0)))
+                    if header.get("evicted"):
+                        out["evicted"] = True
+                        out["error"] = (f"subscriber evicted: "
+                                        f"{header.get('reason', '?')}")
+                        out["gadget_error"] = True
+                elif t == wire.EV_WINDOW:
+                    if on_window:
+                        on_window(self.node_name,
+                                  header.get("window", {}))
                 elif "error" in header:
                     out["error"] = header["error"]
                     if header.get("unknown_run"):
@@ -271,6 +376,16 @@ class AgentClient:
         h, _ = wire.decode_msg(method(wire.encode_msg(req),
                                       timeout=self.rpc_deadline))
         return h
+
+    def shared_runs(self, gadget: str = "") -> list[dict]:
+        """Live shared runs on this node (DumpState `runs` rows filtered
+        to shared + not-done), the attach-by-key discovery surface: each
+        row carries run_id, subscriber rows, queue depths, drops, and
+        keepalive state."""
+        rows = self.dump_state().get("runs") or []
+        return [r for r in rows
+                if r.get("shared") and not r.get("done")
+                and (not gadget or r.get("gadget") == gadget)]
 
     def flight_record(self, max_spans: int = 0) -> dict:
         """The agent's flight recorder (recent spans/logs/errors/facts),

@@ -10,6 +10,14 @@ and a health service (daemon main.go:224-245).
 
 gRPC methods are registered with generic handlers + identity serializers;
 message bodies use wire.py framing.
+
+Shared-run plane (ISSUE 12): a run is a first-class shared resource —
+SharedRun fans one gadget's stream out to N reference-counted
+Subscribers, each with its own seq space, bounded queue, drop policy,
+priority class, and evict-after stall window; admission control bounds
+subscriber count and queued capacity (low priority refused first), and
+the last detach starts a keepalive countdown instead of killing the
+capture. See docs/robustness.md "Shared runs & overload".
 """
 
 from __future__ import annotations
@@ -47,6 +55,25 @@ EVENT_BUFFER = 1024  # ref: service.go:134 bounded buffer, drop-on-full
 # overridable via the run request (`ring` / `linger`).
 RESUME_RING = 1024
 RESUME_LINGER = 10.0
+
+# shared-run overload defaults (per-run / per-subscriber overridable via
+# the run request — validated loudly both here and in the client params
+# layer): bounded per-subscriber queues with an explicit drop policy, a
+# per-run subscriber count + queued-capacity budget, and a stall window
+# after which a wedged subscriber is EVICTED with a labeled terminal
+# record instead of silently rotting.
+SUB_QUEUE = EVENT_BUFFER
+MAX_SUBSCRIBERS = 16
+SUB_BUDGET = 16384              # total queued-message capacity per run
+EVICT_AFTER = 10.0
+DROP_POLICIES = wire.DROP_POLICIES
+PRIORITIES = wire.PRIORITIES
+TIERS = wire.TIERS
+# admission headroom: the fraction of the run's subscriber budget a
+# class may fill — low-priority admissions are refused FIRST as the run
+# approaches saturation (PSketch-style priority classes under a fixed
+# budget), so the important consumers stay whole.
+ADMIT_HEADROOM = {"high": 1.0, "normal": 0.85, "low": 0.6}
 
 log = logging.getLogger("ig-tpu.agent")
 
@@ -92,181 +119,669 @@ _tm_stream_resumes = counter("ig_agent_stream_resumes_total",
 _tm_detached_runs = gauge("ig_agent_detached_runs",
                           "resumable runs currently lingering with no "
                           "client attached")
+# shared-run / overload-protection plane
+_tm_run_subs = gauge("ig_agent_run_subscribers",
+                     "live subscribers per shared gadget run", ("run",))
+_tm_sub_drops = counter("ig_agent_subscriber_drops_total",
+                        "records dropped by a slow subscriber's own "
+                        "bounded queue (never stalls the gadget or its "
+                        "peers)", ("run", "policy", "class"))
+_tm_sub_evictions = counter("ig_agent_subscriber_evictions_total",
+                            "subscribers evicted after stalling past "
+                            "their evict-after window")
+_tm_attach_refused = counter("ig_agent_attach_refused_total",
+                             "shared-run attach admissions refused",
+                             ("reason",))
 
 
-class RunStream:
-    """Per-run outbound stream state that survives client disconnects.
+def _validate_sub_opts(opts: dict) -> str | None:
+    """Server-side guard on subscriber options: an unknown policy or
+    class must refuse the attach loudly, never default silently."""
+    policy = opts.get("drop_policy") or "drop-oldest"
+    if policy not in DROP_POLICIES:
+        return f"unknown drop policy {policy!r} (want {DROP_POLICIES})"
+    priority = opts.get("priority") or "normal"
+    if priority not in PRIORITIES:
+        return f"unknown priority class {priority!r} (want {PRIORITIES})"
+    tier = opts.get("tier") or "full"
+    if tier not in TIERS:
+        return f"unknown delivery tier {tier!r} (want {TIERS})"
+    try:
+        if opts.get("queue") is not None and int(opts["queue"]) < 1:
+            return f"subscriber queue bound must be >= 1, got {opts['queue']}"
+        if opts.get("evict_after") is not None and \
+                float(opts["evict_after"]) <= 0:
+            return f"evict_after must be > 0, got {opts['evict_after']}"
+    except (TypeError, ValueError) as e:
+        return f"bad subscriber option: {e}"
+    return None
 
-    The serving RPC generator used to own the queue and the seq counter,
-    so a dropped connection destroyed both and the run with them. This
-    object outlives any single RPC: every outbound message gets its seq
-    here and lands in a bounded replay ring; an attached client also
-    gets it on a live queue. When the client vanishes the run DETACHES
-    (ring keeps filling) and lingers for `linger` seconds awaiting a
-    `resume {run_id, last_seq}` re-attach, which replays ring messages
-    with seq > last_seq — no duplicates by construction — and reports
-    how many seqs overflowed the ring (`missed`, healed upstream by
-    sealed-window backfill). Non-resumable runs keep the old semantics:
-    disconnect cancels the run immediately.
+
+# kinds a summary-tier subscriber receives: harvest summaries, alert
+# transitions, sealed-window announcements, and trailers/acks — never
+# raw rows/batches or per-record logs. Cheap consumers ride one shared
+# harvest without paying for the firehose.
+_SUMMARY_KINDS = frozenset({
+    wire.EV_SUMMARY, wire.EV_ALERT, wire.EV_WINDOW, wire.EV_RESULT,
+    wire.EV_CONTROL_ACK, wire.EV_RESUME_ACK, wire.EV_DROP_NOTICE,
+    wire.EV_ATTACH_ACK,
+})
+
+
+class Subscriber:
+    """One consumer of a SharedRun: own outbound seq counter, own
+    bounded queue with a validated drop policy, own cursor into the
+    run's shared replay ring.
+
+    A slow subscriber drops ITS OWN records (accounted per drop in
+    `ig_agent_subscriber_drops_total{run,policy,class}` and reported on
+    the wire via EV_DROP_NOTICE) and never stalls the gadget or its
+    peers; one stalled past `evict_after` is evicted with a labeled
+    terminal record. All mutation happens under the owning SharedRun's
+    lock.
+    """
+
+    def __init__(self, sub_id: str, run_id: str, gadget: str, *,
+                 priority: str = "normal", policy: str = "drop-oldest",
+                 queue_max: int = SUB_QUEUE,
+                 evict_after: float = EVICT_AFTER, tier: str = "full",
+                 stamp_ring: int = RESUME_RING):
+        self.sub_id = sub_id
+        self.run_id = run_id
+        self.gadget = gadget
+        self.priority = priority
+        self.policy = policy
+        self.queue_max = max(int(queue_max), 1)
+        self.evict_after = float(evict_after)
+        self.tier = tier
+        self.seq = 0
+        self.drops = 0                 # records this sub's queue dropped
+        self._drops_unreported = 0     # not yet carried by a DROP_NOTICE
+        self.evicted = False
+        self.left = False              # permanently gone (stop/evict)
+        self.done = False              # saw the end-of-stream sentinel
+        self.attaches = 0
+        self.cursor = 0                # highest ring index stamped
+        self.stalled_since: float | None = None
+        self.detached_since: float | None = None
+        self._q: queue.Queue | None = None
+        self._gen = 0
+        # (seq, ring_index | None, encoded | None): the stamped tail for
+        # resume replay — ring entries by index (re-encoded on demand),
+        # sub-local control records (acks/notices) by encoded bytes
+        self._stamps: collections.deque = collections.deque(
+            maxlen=max(int(stamp_ring), 1))
+        self._m_drops = _tm_sub_drops.labels(run=run_id, policy=policy,
+                                             **{"class": priority})
+
+    @property
+    def attached(self) -> bool:
+        return self._q is not None
+
+    def wants(self, kind: int) -> bool:
+        if self.tier != "summary":
+            return True
+        return (kind >> wire.EV_LOG_SHIFT) == 0 and kind in _SUMMARY_KINDS
+
+    # delivery (run lock held) ------------------------------------------
+
+    def deliver(self, index: int, kind: int, header: dict, payload: bytes,
+                force: bool) -> None:
+        if self.left or self.done:
+            return
+        if self._q is None:
+            return  # detached: cursor lags, the shared ring keeps the tail
+        if not self.wants(kind):
+            self.cursor = index  # consumed by the tier filter, no seq
+            return
+        self.cursor = index
+        self.seq += 1
+        msg = wire.encode_msg({**header, "seq": self.seq, "type": kind},
+                              payload)
+        self._stamps.append((self.seq, index, None))
+        self._put(msg, force)
+
+    def deliver_local(self, kind: int, header: dict, payload: bytes = b"",
+                      force: bool = False) -> None:
+        """A sub-local control record (drop notice, eviction trailer):
+        seq-stamped like everything else so client accounting stays
+        exact, retained encoded for resume replay."""
+        if self.done:
+            return
+        self.seq += 1
+        msg = wire.encode_msg({**header, "seq": self.seq, "type": kind},
+                              payload)
+        self._stamps.append((self.seq, None, msg))
+        self._put(msg, force)
+
+    def _put(self, msg: bytes, force: bool) -> None:
+        q = self._q
+        if q is None:
+            return
+        try:
+            q.put_nowait(msg)
+            # hysteresis: a consumer is un-stalled when its queue has
+            # genuinely drained, not when one slow read opened one slot
+            # (that would reset the evict clock on every trickle)
+            if self.stalled_since is not None \
+                    and q.qsize() <= self.queue_max // 2:
+                self.stalled_since = None
+            return
+        except queue.Full:
+            if self.stalled_since is None:
+                self.stalled_since = time.monotonic()
+            if not force and self.policy == "drop-newest":
+                # the new record is the casualty; the client sees a seq
+                # gap and the next DROP_NOTICE carries the count
+                self._record_drop()
+                return
+            # drop-oldest (and all trailers): evict queued records until
+            # the new one fits — a full queue must not eat a result
+            while True:
+                try:
+                    q.put_nowait(msg)
+                    return
+                except queue.Full:
+                    try:
+                        q.get_nowait()
+                        self._record_drop()
+                    except queue.Empty:
+                        pass
+
+    def _record_drop(self) -> None:
+        self.drops += 1
+        self._drops_unreported += 1
+        self._m_drops.inc()
+
+    def maybe_notice(self, node: str) -> None:
+        """Lazily report accumulated drops once the queue has room again
+        (run lock held): the notice itself must not thrash a full
+        queue."""
+        q = self._q
+        if (self._drops_unreported <= 0 or q is None
+                or q.qsize() >= self.queue_max - 1):
+            return
+        dropped, self._drops_unreported = self._drops_unreported, 0
+        self.deliver_local(wire.EV_DROP_NOTICE, {
+            "node": node, "sub_id": self.sub_id, "dropped": dropped,
+            "drops_total": self.drops, "policy": self.policy,
+            "class": self.priority})
+
+    # attach plumbing (run lock held) -----------------------------------
+
+    def attach_queue(self, replay: list[bytes], done: bool
+                     ) -> tuple[queue.Queue, int]:
+        q: queue.Queue = queue.Queue(
+            maxsize=self.queue_max + len(replay) + 8)
+        for m in replay:
+            q.put_nowait(m)
+        if done:
+            q.put_nowait(None)
+        self._q = q
+        self._gen += 1
+        self.attaches += 1
+        self.stalled_since = None
+        self.detached_since = None
+        return q, self._gen
+
+    def owns_locked(self, gen: int) -> bool:
+        return self._gen == gen and self._q is not None
+
+    def sentinel(self) -> None:
+        """End-of-stream for this subscriber; never blocks."""
+        self.done = True
+        q = self._q
+        if q is None:
+            return
+        while True:
+            try:
+                q.put_nowait(None)
+                return
+            except queue.Full:
+                try:
+                    q.get_nowait()
+                    self._record_drop()
+                except queue.Empty:
+                    pass
+
+    def row(self, now: float) -> dict:
+        q = self._q
+        return {
+            "sub_id": self.sub_id, "priority": self.priority,
+            "policy": self.policy, "tier": self.tier, "seq": self.seq,
+            "drops": self.drops, "attached": self.attached,
+            "attaches": self.attaches, "evicted": self.evicted,
+            "left": self.left, "queue_depth": q.qsize() if q else 0,
+            "queue_max": self.queue_max,
+            "stalled_for": (round(now - self.stalled_since, 3)
+                            if self.stalled_since is not None else 0.0),
+        }
+
+
+class SharedRun:
+    """Per-run outbound state shared by N subscribers, outliving any
+    single RPC (the PR-8 RunStream grown into a first-class shared
+    resource).
+
+    Every outbound message gets a run-level ring index and lands in ONE
+    bounded replay ring; each attached subscriber stamps its OWN seq and
+    gets the message on its OWN bounded queue (drop policy + priority
+    class + evict-after — a slow consumer can only hurt itself). A
+    disconnected subscriber detaches (the ring keeps the tail at its
+    cursor) and resumes with `resume {run_id, last_seq[, sub_id]}` —
+    replaying its stamped-but-lost tail with the ORIGINAL seqs, then
+    catching up from the shared ring with fresh seqs: no duplicates by
+    construction, ring overflow reported as `missed` (healed upstream by
+    sealed-window backfill). When the last attached subscriber detaches
+    the gadget keeps running for `keepalive` seconds awaiting a
+    (re-)attach, so dashboard churn doesn't thrash capture setup;
+    non-resumable, non-shared runs keep the original cancel-on-
+    disconnect contract exactly.
     """
 
     def __init__(self, run_id: str, gadget: str, *, resumable: bool = False,
-                 linger: float = RESUME_LINGER, ring_size: int = RESUME_RING):
+                 linger: float = RESUME_LINGER, ring_size: int = RESUME_RING,
+                 shared: bool = False, share_key: str = "",
+                 keepalive: float | None = None,
+                 max_subscribers: int = MAX_SUBSCRIBERS,
+                 sub_budget: int = SUB_BUDGET,
+                 node: str = ""):
         self.run_id = run_id
         self.gadget = gadget
+        self.node = node
         self.resumable = bool(resumable)
+        self.shared = bool(shared)
+        self.share_key = share_key
         self.linger = float(linger)
+        # last detach starts this countdown before the gadget actually
+        # stops (defaults to the resume linger for PR-8 compatibility)
+        self.keepalive = float(keepalive if keepalive is not None
+                               else linger)
+        self.max_subscribers = max(int(max_subscribers), 1)
+        self.sub_budget = max(int(sub_budget), 1)
+        self._ring_size = max(int(ring_size), 1)
         self._mu = threading.Lock()
+        # (index, kind, header, payload) — raw, encoded per subscriber
         self._ring: collections.deque = collections.deque(
-            maxlen=max(int(ring_size), 1))
-        self._q: queue.Queue | None = None
-        self._gen = 0
-        self.seq = 0
-        self.dropped = 0
+            maxlen=self._ring_size)
+        self.index = 0
+        self._subs: dict[str, Subscriber] = {}
+        self._order: list[str] = []     # attach order; [0] is primary
+        self._next_sub = 0
         self.done = False
         self.detached_at: float | None = None
         self.attaches = 0
-        self._linger_timer: threading.Timer | None = None
+        self._keepalive_timer: threading.Timer | None = None
         self.ctx = None  # the run's GadgetContext, set before first push
         self._m_msgs = _tm_stream_msgs.labels(gadget=gadget)
         self._m_dropped = _tm_stream_dropped.labels(gadget=gadget)
         self._m_qdepth = _tm_stream_q.labels(gadget=gadget)
+        self._m_subs = _tm_run_subs.labels(run=run_id)
+
+    # -- introspection ------------------------------------------------------
 
     def is_attached(self) -> bool:
         with self._mu:
-            return self._q is not None
+            return any(s.attached for s in self._subs.values())
 
-    def owns(self, gen: int) -> bool:
+    def owns(self, sub: Subscriber, gen: int) -> bool:
         with self._mu:
-            return self._gen == gen and self._q is not None
+            return sub.owns_locked(gen)
+
+    @property
+    def seq(self) -> int:
+        """Highest subscriber seq (DumpState/debug view; per-subscriber
+        seqs are the wire truth)."""
+        with self._mu:
+            return max((s.seq for s in self._subs.values()), default=0)
+
+    @property
+    def dropped(self) -> int:
+        with self._mu:
+            return sum(s.drops for s in self._subs.values())
+
+    def live_subscribers(self) -> int:
+        with self._mu:
+            return self._live_count_locked()
+
+    def _live_count_locked(self) -> int:
+        return sum(1 for s in self._subs.values() if not s.left)
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self, opts: dict) -> Subscriber | dict:
+        """Admission-control a new subscriber; returns the Subscriber or
+        a typed refusal dict {refused, reason, detail}. Low-priority
+        admissions are refused first as the run nears its budget."""
+        bad = _validate_sub_opts(opts)
+        if bad is not None:
+            _tm_attach_refused.labels(reason="bad-options").inc()
+            return {"refused": True, "reason": "bad-options", "detail": bad}
+        priority = opts.get("priority") or "normal"
+        queue_max = int(opts.get("queue") or SUB_QUEUE)
+        with self._mu:
+            # expired ghosts must not crowd out live admissions; any
+            # cancel-context the expiry returns is deliberately ignored
+            # — a subscriber is being admitted right now, so the run
+            # must keep living regardless of the ghosts' departure
+            self._expire_stale_locked(time.monotonic())
+            if self.done:
+                _tm_attach_refused.labels(reason="run-done").inc()
+                return {"refused": True, "reason": "run-done",
+                        "detail": f"run {self.run_id} already ended"}
+            if self._live_count_locked() >= self.max_subscribers:
+                _tm_attach_refused.labels(reason="max-subscribers").inc()
+                return {"refused": True, "reason": "max-subscribers",
+                        "detail": f"run {self.run_id} already serves "
+                                  f"{self.max_subscribers} subscriber(s)"}
+            usage = sum(s.queue_max for s in self._subs.values()
+                        if not s.left)
+            headroom = ADMIT_HEADROOM.get(priority, 1.0)
+            if usage + queue_max > self.sub_budget * headroom:
+                _tm_attach_refused.labels(reason="memory-budget").inc()
+                return {"refused": True, "reason": "memory-budget",
+                        "detail": f"{priority} admission would put queued "
+                                  f"capacity at {usage + queue_max} > "
+                                  f"{headroom:.0%} of budget "
+                                  f"{self.sub_budget}"}
+            sub_id = str(opts.get("id") or "")
+            if not sub_id or sub_id in self._subs:
+                self._next_sub += 1
+                sub_id = f"s{self._next_sub}"
+            sub = Subscriber(
+                sub_id, self.run_id, self.gadget, priority=priority,
+                policy=opts.get("drop_policy") or "drop-oldest",
+                queue_max=queue_max,
+                evict_after=float(opts.get("evict_after") or EVICT_AFTER),
+                tier=opts.get("tier") or "full",
+                stamp_ring=self._ring_size)
+            sub.cursor = self.index  # joins live; history via attach()
+            self._subs[sub_id] = sub
+            self._order.append(sub_id)
+            self._m_subs.set(self._live_count_locked())
+            return sub
+
+    # -- delivery -----------------------------------------------------------
 
     def push(self, kind: int, header: dict, payload: bytes = b"",
              force: bool = False) -> None:
-        """Stamp seq, retain in the ring, deliver to the live client if
-        one is attached. `force` (trailers: EV_RESULT / EV_CONTROL_ACK)
-        evicts the oldest queued message instead of dropping the new one
-        — a full queue must not eat the run's result."""
+        """Retain one raw copy in the shared ring, fan out to every
+        subscriber under its own seq/queue/policy. `force` (trailers:
+        EV_RESULT / EV_CONTROL_ACK) evicts queued records instead of
+        dropping the trailer — a full queue must not eat the result."""
+        evict: list[Subscriber] = []
         with self._mu:
-            self.seq += 1
-            msg = wire.encode_msg({**header, "seq": self.seq, "type": kind},
-                                  payload)
-            self._ring.append((self.seq, msg))
+            self.index += 1
+            self._ring.append((self.index, kind, dict(header), payload))
             self._m_msgs.inc()
-            q = self._q
-            if q is None:
-                return
-            try:
-                q.put_nowait(msg)
-                self._m_qdepth.set(q.qsize())
-            except queue.Full:
-                if not force:
-                    self.dropped += 1  # ref: service.go:160-167 drop-on-full
-                    self._m_dropped.inc()
-                    return
-                while True:
-                    try:
-                        q.put_nowait(msg)
-                        return
-                    except queue.Full:
-                        try:
-                            q.get_nowait()
-                            self.dropped += 1
-                            self._m_dropped.inc()
-                        except queue.Empty:
-                            pass
+            now = time.monotonic()
+            depth = 0
+            for sub in self._subs.values():
+                before = sub.drops
+                sub.deliver(self.index, kind, header, payload, force)
+                if sub.drops > before:
+                    self._m_dropped.inc(sub.drops - before)
+                sub.maybe_notice(self.node)
+                if sub._q is not None:
+                    depth = max(depth, sub._q.qsize())
+                if (sub.attached and not sub.left
+                        and sub.stalled_since is not None
+                        and now - sub.stalled_since > sub.evict_after):
+                    evict.append(sub)
+            self._m_qdepth.set(depth)
+            stale_ctx = self._expire_stale_locked(now)
+        if stale_ctx is not None:
+            stale_ctx.cancel()
+        for sub in evict:
+            self.evict(sub, f"stalled > {sub.evict_after:g}s "
+                            f"(queue full, client not draining)")
 
-    def attach(self, last_seq: int) -> tuple[queue.Queue, int, dict]:
-        """(Re-)attach a client that holds everything up to last_seq.
-        Returns (live queue, attach generation, resume-ack dict)."""
+    def evict(self, sub: Subscriber, why: str) -> None:
+        """A wedged subscriber gets a labeled terminal record and its
+        stream ends; the gadget and its peers never notice."""
         with self._mu:
-            if self._linger_timer is not None:
-                self._linger_timer.cancel()
-                self._linger_timer = None
+            if sub.left or sub.done:
+                return
+            sub.evicted = True
+            sub.deliver_local(wire.EV_DROP_NOTICE, {
+                "node": self.node, "sub_id": sub.sub_id, "evicted": True,
+                "reason": why, "dropped": sub._drops_unreported,
+                "drops_total": sub.drops, "policy": sub.policy,
+                "class": sub.priority}, force=True)
+            sub._drops_unreported = 0
+            _tm_sub_evictions.inc()
+        log.warning("run %s (%s): evicting subscriber %s (%s, %s): %s",
+                    self.run_id, self.gadget, sub.sub_id, sub.priority,
+                    sub.policy, why)
+        self.leave(sub)
+
+    # -- attach / detach / leave --------------------------------------------
+
+    def attach_subscriber(self, sub: Subscriber, last_seq: int
+                          ) -> tuple[queue.Queue, int, dict]:
+        """(Re-)attach a subscriber that holds everything up to
+        last_seq. Replays its stamped-but-lost tail with the ORIGINAL
+        seqs, then catches up from the shared ring (fresh seqs); what
+        fell off either ring is `missed` — no duplicates, no silent
+        holes."""
+        with self._mu:
+            self._cancel_keepalive_locked()
             if self.detached_at is not None:
                 _tm_detached_runs.dec()
                 self.detached_at = None
-            replay = [(s, m) for s, m in self._ring if s > last_seq]
-            if replay:
-                missed = max(0, replay[0][0] - last_seq - 1)
-            else:
-                missed = max(0, self.seq - last_seq)
-            q: queue.Queue = queue.Queue(
-                maxsize=EVENT_BUFFER + len(replay) + 8)
-            for _s, m in replay:
-                q.put_nowait(m)
-            if self.done:
-                q.put_nowait(None)
-            self._q = q
-            self._gen += 1
             self.attaches += 1
-            ack = {"run_id": self.run_id, "last_seq": int(last_seq),
-                   "missed": int(missed), "replayed": len(replay),
-                   "seq": self.seq, "attach": self.attaches}
-            return q, self._gen, ack
+            ring_by_index = {i: (k, h, p) for i, k, h, p in self._ring}
+            replay: list[bytes] = []
+            missed = 0
+            # 1) stamped tail the client lost in transit
+            stamped = [t for t in sub._stamps if t[0] > last_seq]
+            if stamped:
+                missed += max(0, stamped[0][0] - last_seq - 1)
+            elif sub.seq > last_seq:
+                missed += sub.seq - last_seq
+            for s, idx, enc in stamped:
+                if enc is not None:
+                    replay.append(enc)
+                elif idx in ring_by_index:
+                    k, h, p = ring_by_index[idx]
+                    replay.append(wire.encode_msg(
+                        {**h, "seq": s, "type": k}, p))
+                else:
+                    missed += 1
+            replayed = len(replay)
+            # 2) catch-up: ring entries past this sub's cursor, stamped
+            # fresh now (entries that already fell off are missed)
+            if self._ring:
+                first = self._ring[0][0]
+                if first > sub.cursor + 1:
+                    missed += first - sub.cursor - 1
+                for i, k, h, p in self._ring:
+                    if i <= sub.cursor or not sub.wants(k):
+                        if i > sub.cursor:
+                            sub.cursor = i
+                        continue
+                    sub.cursor = i
+                    sub.seq += 1
+                    replay.append(wire.encode_msg(
+                        {**h, "seq": sub.seq, "type": k}, p))
+                    sub._stamps.append((sub.seq, i, None))
+                    replayed += 1
+            elif self.index > sub.cursor:
+                missed += self.index - sub.cursor
+                sub.cursor = self.index
+            q, gen = sub.attach_queue(replay, self.done)
+            self._m_subs.set(self._live_count_locked())
+            ack = {"run_id": self.run_id, "sub_id": sub.sub_id,
+                   "last_seq": int(last_seq), "missed": int(missed),
+                   "replayed": replayed, "seq": sub.seq,
+                   "attach": sub.attaches,
+                   "subscribers": self._live_count_locked(),
+                   "shared": self.shared}
+            return q, gen, ack
 
-    def detach(self, gen: int) -> None:
-        """A serving RPC ended. Only the CURRENT attachment detaches (a
-        generator superseded by a newer resume is a no-op). Resumable
-        live runs linger awaiting a re-attach; everything else keeps the
-        old cancel-on-disconnect contract."""
+    def resume(self, sub_id: str, last_seq: int
+               ) -> tuple[Subscriber, queue.Queue, int, dict] | None:
+        """Resolve the subscriber a `resume` first-message addresses: by
+        sub_id when given (the supervisor echoes the acked id); without
+        one (PR-8 wire compat — resumes carried no subscriber identity)
+        prefer a DETACHED live subscriber — a resume is by definition a
+        reconnect, and picking an attached peer would hijack its
+        stream. Returns None when nothing matches (answered upstream as
+        unknown_run so the client restarts fresh, exactly the PR-8
+        linger-expiry contract)."""
+        with self._mu:
+            sub = None
+            if sub_id:
+                sub = self._subs.get(sub_id)
+            else:
+                live = [self._subs[sid] for sid in self._order
+                        if sid in self._subs
+                        and not self._subs[sid].left]
+                detached = [s for s in live if not s.attached]
+                if detached:
+                    sub = detached[0]
+                elif live:
+                    sub = live[0]
+            if sub is None or sub.left:
+                return None
+        q, gen, ack = self.attach_subscriber(sub, last_seq)
+        return sub, q, gen, ack
+
+    def detach(self, sub: Subscriber, gen: int) -> None:
+        """A serving RPC ended. Only the subscriber's CURRENT attachment
+        detaches (a generator superseded by a newer resume is a no-op).
+        Resumable/shared runs start the keepalive countdown when the
+        LAST attached subscriber detaches; everything else keeps the old
+        cancel-on-disconnect contract."""
         ctx = None
         with self._mu:
-            if gen != self._gen or self._q is None:
+            if not sub.owns_locked(gen):
                 return
-            self._q = None
+            sub._q = None
+            sub.stalled_since = None
+            sub.detached_since = time.monotonic()
             if self.done:
                 return
-            self.detached_at = time.monotonic()
-            _tm_detached_runs.inc()
-            if self.resumable and self.linger > 0:
-                t = threading.Timer(self.linger, self._linger_expired)
-                t.daemon = True
-                self._linger_timer = t
-                t.start()
+            if any(s.attached and not s.left
+                   for s in self._subs.values()):
+                return  # peers still live: nothing run-level to do
+            if self.detached_at is None:
+                # leave() may have marked the run detached already while
+                # this subscriber's generator was still draining its
+                # sentinel — one detachment, one gauge increment
+                self.detached_at = time.monotonic()
+                _tm_detached_runs.inc()
+            if (self.resumable or self.shared) and self.keepalive > 0:
+                self._arm_keepalive_locked()
                 return
             ctx = self.ctx
         if ctx is not None:
             ctx.cancel()
 
-    def _linger_expired(self) -> None:
+    def leave(self, sub: Subscriber) -> None:
+        """A subscriber is gone for good (stop request, eviction, or
+        resume-window expiry): it stops receiving, its queue drains to
+        the sentinel, and when the last live subscriber leaves the
+        keepalive countdown (not an immediate stop) decides the gadget's
+        fate."""
         with self._mu:
-            if self._q is not None or self.done:
+            ctx = self._leave_locked(sub)
+        if ctx is not None:
+            ctx.cancel()
+
+    def _leave_locked(self, sub: Subscriber):
+        """Core of leave(); returns a context to cancel AFTER the lock
+        is released (or None)."""
+        if sub.left:
+            return None
+        sub.left = True
+        sub.sentinel()
+        self._m_subs.set(self._live_count_locked())
+        if self.done or self._live_count_locked() > 0:
+            return None
+        if self.detached_at is None:
+            self.detached_at = time.monotonic()
+            _tm_detached_runs.inc()
+        if (self.resumable or self.shared) and self.keepalive > 0:
+            self._arm_keepalive_locked()
+            return None
+        return self.ctx
+
+    def _expire_stale_locked(self, now: float):
+        """A subscriber detached longer than the resume window (the
+        run's `linger`) is gone for good: without this, crash-
+        disconnected dashboards would hold max-subscribers slots and
+        budget capacity for the life of the run. Returns a context to
+        cancel after the lock is released (or None)."""
+        ctx = None
+        for sub in self._subs.values():
+            if (not sub.left and not sub.attached
+                    and sub.detached_since is not None
+                    and now - sub.detached_since > max(self.linger, 0.0)):
+                log.info("run %s (%s): subscriber %s expired after %.1fs "
+                         "detached with no resume", self.run_id,
+                         self.gadget, sub.sub_id, now - sub.detached_since)
+                ctx = self._leave_locked(sub) or ctx
+        return ctx
+
+    def _arm_keepalive_locked(self) -> None:
+        self._cancel_keepalive_locked()
+        t = threading.Timer(self.keepalive, self._keepalive_expired)
+        t.daemon = True
+        self._keepalive_timer = t
+        t.start()
+
+    def _cancel_keepalive_locked(self) -> None:
+        if self._keepalive_timer is not None:
+            self._keepalive_timer.cancel()
+            self._keepalive_timer = None
+
+    def _keepalive_expired(self) -> None:
+        with self._mu:
+            # a LEFT subscriber still draining its sentinel is not a
+            # reason to keep the gadget alive — only live attachments
+            if self.done or any(s.attached and not s.left
+                                for s in self._subs.values()):
                 return
-            # cancel UNDER the lock: a resume attaching right now holds
-            # the same lock in attach(), so it either lands before this
-            # check (we return) or after the cancel (and sees the run
-            # wind down with its trailer) — never a cancelled-under-
-            # the-client limbo
+            # cancel UNDER the lock: an attach landing right now holds
+            # the same lock, so it either lands before this check (we
+            # return) or after the cancel (and sees the run wind down
+            # with its trailer) — never a cancelled-under-the-client
+            # limbo
             if self.ctx is not None:
                 self.ctx.cancel()
-        log.info("run %s (%s): no resume within %.1fs linger, cancelling",
-                 self.run_id, self.gadget, self.linger)
+        log.info("run %s (%s): no (re-)attach within %.1fs keepalive, "
+                 "cancelling", self.run_id, self.gadget, self.keepalive)
+
+    def keepalive_remaining(self) -> float:
+        """Seconds until the lingering run cancels itself (0 when a
+        client is attached or the run ended)."""
+        with self._mu:
+            if self.done or self.detached_at is None \
+                    or self._keepalive_timer is None:
+                return 0.0
+            return max(
+                0.0, self.keepalive - (time.monotonic() - self.detached_at))
 
     def finish(self) -> None:
-        """The run ended: wake the attached client with the end-of-stream
-        sentinel (never blocking — a gone client must not leak the run
-        thread)."""
+        """The run ended: wake every attached subscriber with the
+        end-of-stream sentinel (never blocking — a gone client must not
+        leak the run thread)."""
         with self._mu:
             self.done = True
-            if self._linger_timer is not None:
-                self._linger_timer.cancel()
-                self._linger_timer = None
+            self._cancel_keepalive_locked()
             if self.detached_at is not None:
                 _tm_detached_runs.dec()
                 self.detached_at = None
-            q = self._q
-            if q is None:
-                return
-            while True:
-                try:
-                    q.put_nowait(None)
-                    return
-                except queue.Full:
-                    try:
-                        q.get_nowait()
-                        self.dropped += 1
-                    except queue.Empty:
-                        pass
+            for sub in self._subs.values():
+                sub.sentinel()
+            self._m_subs.set(0)
+
+    def subscriber_rows(self) -> list[dict]:
+        now = time.monotonic()
+        with self._mu:
+            return [self._subs[sid].row(now) for sid in self._order
+                    if sid in self._subs]
 
 
 class AgentServer:
@@ -274,10 +789,15 @@ class AgentServer:
         self.node_name = node_name
         self.runtime = LocalRuntime(node_name=node_name)
         self._runs: dict[str, GadgetContext] = {}
-        # run_id → RunStream: the resume plane's registry. Entries retire
-        # a linger-window after the run ends so a client that dropped
-        # right before completion can still re-attach for the tail.
-        self._streams: dict[str, RunStream] = {}
+        # run_id → SharedRun: the resume/shared plane's registry. Entries
+        # retire a keepalive-window after the run ends so a client that
+        # dropped right before completion can still re-attach for the
+        # tail.
+        self._streams: dict[str, SharedRun] = {}
+        # share_key → run_id: the first RunGadget request for a (gadget,
+        # resolved-params) key starts the gadget; compatible requests
+        # attach to the SAME running pipeline as subscribers.
+        self._shared: dict[str, str] = {}
         self._runs_mu = threading.Lock()
         # legacy CRD-path serving (ref: main.go:262-299 starts the Trace
         # controller inside the node daemon)
@@ -340,6 +860,9 @@ class AgentServer:
             if header.get("resume"):
                 yield from self._resume_stream(header["resume"],
                                                request_iterator, context)
+            elif header.get("attach"):
+                yield from self._attach_stream(header["attach"],
+                                               request_iterator, context)
             else:
                 yield from self._run_gadget_traced(header, rpc_span,
                                                    request_iterator, context)
@@ -354,6 +877,7 @@ class AgentServer:
         to restart fresh and heal the gap from sealed windows instead."""
         run_id = str(resume.get("run_id") or "")
         last_seq = int(resume.get("last_seq") or 0)
+        sub_id = str(resume.get("sub_id") or "")
         with self._runs_mu:
             state = self._streams.get(run_id)
         if state is None:
@@ -362,49 +886,115 @@ class AgentServer:
                           f"nothing to resume",
                  "unknown_run": True, "node": self.node_name})
             return
-        q, gen, ack = state.attach(last_seq)
+        resolved = state.resume(sub_id, last_seq)
+        if resolved is None:
+            # the run lives but this subscriber is gone (left, evicted,
+            # or expired): answer unknown_run — the PR-8 linger-expiry
+            # contract — so the supervisor backfills and restarts fresh
+            # (a share=true restart re-attaches as a NEW subscriber)
+            yield wire.encode_msg(
+                {"error": f"subscriber {sub_id or '<primary>'!r} no longer "
+                          f"exists on run {run_id!r} on {self.node_name}: "
+                          f"nothing to resume",
+                 "unknown_run": True, "node": self.node_name})
+            return
+        sub, q, gen, ack = resolved
         _tm_stream_resumes.labels(gadget=state.gadget).inc()
-        log.info("run %s (%s): client re-attached at seq %d "
+        log.info("run %s (%s): subscriber %s re-attached at seq %d "
                  "(replayed %d, missed %d)", run_id, state.gadget,
-                 last_seq, ack["replayed"], ack["missed"])
+                 sub.sub_id, last_seq, ack["replayed"], ack["missed"])
         yield wire.encode_msg({"type": wire.EV_RESUME_ACK,
                                "node": self.node_name, "resume": ack})
         threading.Thread(target=self._control_loop,
-                         args=(request_iterator, state.ctx, state),
+                         args=(request_iterator, state.ctx, state, sub),
                          daemon=True).start()
         try:
-            yield from self._serve_attached(state, q, gen, context)
+            yield from self._serve_attached(state, sub, q, gen, context)
         finally:
-            state.detach(gen)
+            state.detach(sub, gen)
+
+    def _attach_stream(self, attach: dict, request_iterator,
+                       context) -> Iterator[bytes]:
+        """Attach a NEW subscriber to an already-running shared gadget,
+        by run_id or by share key: admission-controlled (max-subscribers
+        + per-run subscriber budget, low priority refused first), ACKed
+        (or refused) with a typed EV_ATTACH_ACK. The subscriber rides
+        its own seq space/queue/policy from the moment of admission."""
+        run_id = str(attach.get("run_id") or "")
+        key = str(attach.get("key") or "")
+        with self._runs_mu:
+            if not run_id and key:
+                run_id = self._shared.get(key, "")
+            state = self._streams.get(run_id) if run_id else None
+        if state is None or state.done:
+            yield wire.encode_msg(
+                {"error": f"unknown run {run_id or key!r} on "
+                          f"{self.node_name}: nothing to attach to",
+                 "unknown_run": True, "node": self.node_name})
+            return
+        admitted = state.admit(attach)
+        if isinstance(admitted, dict):  # typed refusal
+            yield wire.encode_msg(
+                {"type": wire.EV_ATTACH_ACK, "node": self.node_name,
+                 "attach": {**admitted, "run_id": state.run_id},
+                 "error": f"attach refused ({admitted['reason']}): "
+                          f"{admitted['detail']}"})
+            return
+        sub = admitted
+        q, gen, ack = state.attach_subscriber(sub, int(attach.get(
+            "last_seq") or 0))
+        log.info("run %s (%s): subscriber %s attached (%s, %s, tier=%s; "
+                 "%d live)", state.run_id, state.gadget, sub.sub_id,
+                 sub.priority, sub.policy, sub.tier, ack["subscribers"])
+        yield wire.encode_msg({"type": wire.EV_ATTACH_ACK,
+                               "node": self.node_name, "attach": ack})
+        threading.Thread(target=self._control_loop,
+                         args=(request_iterator, state.ctx, state, sub),
+                         daemon=True).start()
+        try:
+            yield from self._serve_attached(state, sub, q, gen, context)
+        finally:
+            state.detach(sub, gen)
 
     @staticmethod
-    def _control_loop(request_iterator, ctx, state) -> None:
-        """Client stop requests cancel the run. Transport death is NOT a
-        stop for resumable runs — the serving loop's detach starts the
-        linger window instead; non-resumable runs keep the original
+    def _control_loop(request_iterator, ctx, state, sub=None) -> None:
+        """Client stop requests: on a SHARED run a subscriber's stop
+        detaches that subscriber (last one out starts the keepalive
+        countdown, the gadget never thrashes on dashboard churn); on a
+        private run it cancels the gadget as before. `{"stop": "run"}`
+        force-cancels a shared gadget. Transport death is NOT a stop for
+        resumable/shared runs — the serving loop's detach starts the
+        keepalive window instead; non-resumable runs keep the original
         cancel-on-disconnect contract."""
         try:
             for msg in request_iterator:
                 h, _ = wire.decode_msg(msg)
                 if h.get("stop"):
-                    if ctx is not None:
+                    if (state is not None and state.shared
+                            and sub is not None
+                            and h.get("stop") != "run"):
+                        state.leave(sub)
+                    elif ctx is not None:
                         ctx.cancel()
                     return
         except Exception:  # noqa: BLE001 — iterator died with the client
-            if (state is None or not state.resumable) and ctx is not None:
+            if (state is None or not (state.resumable or state.shared)) \
+                    and ctx is not None:
                 ctx.cancel()
 
-    def _serve_attached(self, state: RunStream, q: queue.Queue, gen: int,
+    def _serve_attached(self, state: SharedRun, sub: Subscriber,
+                        q: queue.Queue, gen: int,
                         context) -> Iterator[bytes]:
-        """Pump one attachment's queue onto the wire until end-of-run,
-        client death, or takeover by a newer resume attachment."""
+        """Pump one subscriber attachment's queue onto the wire until
+        end-of-run, client death, eviction, or takeover by a newer
+        resume attachment."""
         while True:
             try:
                 item = q.get(timeout=0.25)
             except queue.Empty:
                 if not context.is_active():
                     return
-                if not state.owns(gen):
+                if not state.owns(sub, gen):
                     return  # a newer resume took the stream over
                 continue
             if item is None:
@@ -413,16 +1003,31 @@ class AgentServer:
             if not context.is_active():
                 return
 
-    def _retire_stream(self, state: RunStream, after: float) -> None:
+    def _retire_stream(self, state: SharedRun, after: float) -> None:
         def retire():
             with self._runs_mu:
                 # identity-guarded: an unknown-run restart may have
                 # re-registered the same run_id with a NEW stream state
                 if self._streams.get(state.run_id) is state:
                     self._streams.pop(state.run_id, None)
+                if state.share_key and \
+                        self._shared.get(state.share_key) == state.run_id:
+                    self._shared.pop(state.share_key, None)
         t = threading.Timer(max(after, 0.5), retire)
         t.daemon = True
         t.start()
+
+    @staticmethod
+    def share_key(run: dict) -> str:
+        """The shared-run identity: gadget + resolved flat params +
+        requested outputs. Two requests with the same key drive the SAME
+        capture/sketch pipeline; anything that would change what the
+        gadget computes or emits forks the key."""
+        return json.dumps([
+            run.get("category", ""), run.get("name", ""),
+            sorted((run.get("params") or {}).items()),
+            sorted(set(run.get("output") or ["json"])),
+        ], separators=(",", ":"))
 
     def _run_gadget_traced(self, header: dict, rpc_span, request_iterator,
                            context) -> Iterator[bytes]:
@@ -430,6 +1035,25 @@ class AgentServer:
         if not run:
             yield wire.encode_msg({"error": "first message must be a run request"})
             return
+
+        sub_opts = dict(run.get("subscriber") or {})
+        bad = _validate_sub_opts(sub_opts)
+        if bad is not None:
+            yield wire.encode_msg({"error": bad})
+            return
+
+        if run.get("share"):
+            key = self.share_key(run)
+            with self._runs_mu:
+                existing = self._streams.get(self._shared.get(key, ""))
+            if existing is not None and not existing.done:
+                # the gadget is already running for this exact request:
+                # attach as a subscriber instead of paying for a second
+                # capture + sketch + history pipeline
+                yield from self._attach_stream(
+                    {**sub_opts, "run_id": existing.run_id},
+                    request_iterator, context)
+                return
 
         try:
             desc = gadget_registry.get(run["category"], run["name"])
@@ -465,18 +1089,57 @@ class AgentServer:
         run_logger = logging.Logger(f"ig-tpu.{desc.full_name}.{ctx.run_id}")
         run_logger.parent = logging.getLogger(f"ig-tpu.{desc.full_name}")
         ctx.logger = run_logger
-        # resume plane: the client opts in per run; the stream state
-        # below outlives this RPC so a reconnect can re-attach
-        state = RunStream(
+        # resume/shared plane: the client opts in per run; the stream
+        # state below outlives this RPC so a reconnect can re-attach and
+        # later compatible requests can subscribe
+        share_key = self.share_key(run) if run.get("share") else ""
+        state = SharedRun(
             ctx.run_id, desc.full_name,
             resumable=bool(run.get("resumable")),
             linger=float(run.get("linger") or RESUME_LINGER),
-            ring_size=int(run.get("ring") or RESUME_RING))
+            ring_size=int(run.get("ring") or RESUME_RING),
+            shared=bool(run.get("share")),
+            share_key=share_key,
+            keepalive=(float(run["keepalive"])
+                       if run.get("keepalive") is not None else None),
+            max_subscribers=int(run.get("max_subscribers")
+                                or MAX_SUBSCRIBERS),
+            sub_budget=int(run.get("sub_budget") or SUB_BUDGET),
+            node=self.node_name)
         state.ctx = ctx
+        primary = state.admit(sub_opts)
+        if isinstance(primary, dict):  # refusal on the FIRST subscriber
+            yield wire.encode_msg(
+                {"type": wire.EV_ATTACH_ACK, "node": self.node_name,
+                 "attach": {**primary, "run_id": ctx.run_id},
+                 "error": f"attach refused ({primary['reason']}): "
+                          f"{primary['detail']}"})
+            return
+        prev = None
+        lost_to = ""
         with self._runs_mu:
-            prev = self._streams.get(ctx.run_id)
-            self._runs[ctx.run_id] = ctx
-            self._streams[ctx.run_id] = state
+            if share_key:
+                # the AUTHORITATIVE share-key decision happens here,
+                # under the registry lock: the early pre-ctx check is an
+                # optimization, and two concurrent first-requests for
+                # one key must not both start gadgets — first to
+                # register wins, the loser attaches to it instead
+                winner = self._streams.get(self._shared.get(share_key, ""))
+                if winner is not None and not winner.done:
+                    lost_to = winner.run_id
+                else:
+                    self._shared[share_key] = ctx.run_id
+            if not lost_to:
+                prev = self._streams.get(ctx.run_id)
+                self._runs[ctx.run_id] = ctx
+                self._streams[ctx.run_id] = state
+        if lost_to:
+            log.info("run %s (%s): lost the share-key race to %s; "
+                     "attaching as a subscriber instead of starting a "
+                     "second gadget", ctx.run_id, desc.full_name, lost_to)
+            yield from self._attach_stream(
+                {**sub_opts, "run_id": lost_to}, request_iterator, context)
+            return
         if prev is not None and not prev.done and prev.ctx is not None:
             # a client restarting under a reused run_id while the
             # previous life still lingers: two gadgets capturing under
@@ -499,11 +1162,11 @@ class AgentServer:
                                       "gadget": desc.full_name},
                                ambient=False)
         yield from self._run_gadget_stream(ctx, desc, outputs, state,
-                                           run_span, request_iterator,
-                                           context)
+                                           primary, run_span,
+                                           request_iterator, context)
 
-    def _run_gadget_stream(self, ctx, desc, outputs, state: RunStream,
-                           run_span, request_iterator,
+    def _run_gadget_stream(self, ctx, desc, outputs, state: SharedRun,
+                           primary: Subscriber, run_span, request_iterator,
                            context) -> Iterator[bytes]:
         cleanup_mu = threading.Lock()
         cleanup_state = {"done": False, "handler": None}
@@ -527,13 +1190,14 @@ class AgentServer:
                     self._runs.pop(ctx.run_id, None)
             _tm_active_runs.dec()
             run_span.__exit__(None, None, None)
-            # keep the stream state around one linger window so a client
-            # that dropped right before the end can resume for the tail
-            self._retire_stream(state, state.linger)
+            # keep the stream state around one linger/keepalive window so
+            # a client that dropped right before the end can resume for
+            # the tail
+            self._retire_stream(state, max(state.linger, state.keepalive))
 
         try:
             yield from self._run_stream_setup_and_serve(
-                ctx, desc, outputs, state, run_span, run_cleanup,
+                ctx, desc, outputs, state, primary, run_span, run_cleanup,
                 cleanup_state, request_iterator, context)
         except GeneratorExit:
             # client disconnect mid-serve: the serving finally already
@@ -549,8 +1213,8 @@ class AgentServer:
             raise
 
     def _run_stream_setup_and_serve(self, ctx, desc, outputs,
-                                    state: RunStream, run_span,
-                                    run_cleanup, cleanup_state,
+                                    state: SharedRun, primary: Subscriber,
+                                    run_span, run_cleanup, cleanup_state,
                                     request_iterator,
                                     context) -> Iterator[bytes]:
         push = state.push
@@ -605,9 +1269,18 @@ class AgentServer:
             push(wire.EV_ALERT, {"node": self.node_name, "alert": alert})
         ctx.extra["on_alert_event"] = on_alert_event
 
-        # control reader: client stop requests cancel the context
+        # sealed-window announcements ride the stream as header-only
+        # EV_WINDOW records: summary-tier subscribers learn a window
+        # exists (and can FetchWindows it) without the raw batches
+        def on_window_sealed(win_header: dict):
+            push(wire.EV_WINDOW, {"node": self.node_name,
+                                  "window": win_header})
+        ctx.extra["on_window_sealed"] = on_window_sealed
+
+        # control reader: client stop requests cancel the context (or
+        # detach the subscriber on a shared run)
         threading.Thread(target=self._control_loop,
-                         args=(request_iterator, ctx, state),
+                         args=(request_iterator, ctx, state, primary),
                          daemon=True).start()
 
         # resolve handler wiring BEFORE spawning the run thread so an
@@ -626,11 +1299,12 @@ class AgentServer:
                  force=True)
             run_cleanup()
             state.finish()
-            q, gen, _ack = state.attach(0)
+            q, gen, _ack = state.attach_subscriber(primary, 0)
             try:
-                yield from self._serve_attached(state, q, gen, context)
+                yield from self._serve_attached(state, primary, q, gen,
+                                                context)
             finally:
-                state.detach(gen)
+                state.detach(primary, gen)
             return
 
         def run_thread():
@@ -662,11 +1336,11 @@ class AgentServer:
         t = threading.Thread(target=run_thread, daemon=True)
         t.start()
 
-        q, gen, _ack = state.attach(0)
+        q, gen, _ack = state.attach_subscriber(primary, 0)
         try:
-            yield from self._serve_attached(state, q, gen, context)
+            yield from self._serve_attached(state, primary, q, gen, context)
         finally:
-            state.detach(gen)
+            state.detach(primary, gen)
 
     # -- ContainerManager (hook-facing; ref: gadgettracermanager.go:151) ----
 
@@ -942,9 +1616,10 @@ class AgentServer:
         with self._runs_mu:
             runs = list(self._runs)
             stream_states = list(self._streams.values())
-        # resume-plane view: every live (or lingering) run stream with
-        # its attach state — `ig-tpu fleet health` reads this to tell a
-        # serving run from one awaiting a resume
+        # resume/shared-plane view: every live (or lingering) run stream
+        # with its attach + subscriber state — `ig-tpu fleet health` and
+        # `ig-tpu fleet runs` read this to tell a serving run from one
+        # awaiting a resume, and a saturated run from an idle one
         now = time.monotonic()
         run_rows = [{
             "run_id": st.run_id, "gadget": st.gadget, "seq": st.seq,
@@ -953,6 +1628,13 @@ class AgentServer:
             "dropped": st.dropped,
             "detached_for": (round(now - st.detached_at, 3)
                              if st.detached_at is not None else 0.0),
+            "shared": st.shared,
+            "subscribers": st.subscriber_rows(),
+            "live_subscribers": st.live_subscribers(),
+            "max_subscribers": st.max_subscribers,
+            "sub_budget": st.sub_budget,
+            "keepalive": st.keepalive,
+            "keepalive_remaining": round(st.keepalive_remaining(), 3),
         } for st in stream_states]
         # container set, as the reference's DumpState does
         # (gadgettracermanager.go:204-219 dumps containers + stacks)

@@ -30,6 +30,12 @@ EV_JOURNAL_MARK = 8     # capture-journal lifecycle marker (capture/)
 EV_WINDOW = 9           # sealed sketch window (history/) — mergeable state
 EV_RESUME_ACK = 10      # resume re-attach acknowledgment (carries the
                         # replay start + how many seqs the ring lost)
+EV_DROP_NOTICE = 11     # per-subscriber overload accounting: a slow
+                        # consumer's own queue dropped records (policy/
+                        # class/count in the header; evicted=True is the
+                        # labeled terminal record of a stalled subscriber)
+EV_ATTACH_ACK = 12      # shared-run attach acknowledgment OR typed
+                        # admission refusal (attach.refused + reason)
 EV_LOG_SHIFT = 16       # type >> 16 = severity when nonzero
 
 # The one registry every EV_* wire id must appear in. Stream decoding,
@@ -49,7 +55,18 @@ WIRE_EVENT_IDS: dict[str, int] = {
     "EV_JOURNAL_MARK": EV_JOURNAL_MARK,
     "EV_WINDOW": EV_WINDOW,
     "EV_RESUME_ACK": EV_RESUME_ACK,
+    "EV_DROP_NOTICE": EV_DROP_NOTICE,
+    "EV_ATTACH_ACK": EV_ATTACH_ACK,
 }
+
+
+# Shared-run subscriber vocabulary — ONE home for the values the client
+# validates before the wire, the agent re-validates server-side, and the
+# runtime params layer offers as one_of choices (three call sites, one
+# truth; like the EV_* registry above).
+DROP_POLICIES = ("drop-oldest", "drop-newest")
+PRIORITIES = ("high", "normal", "low")
+TIERS = ("full", "summary")
 
 
 def encode_msg(header: dict, payload: bytes = b"") -> bytes:

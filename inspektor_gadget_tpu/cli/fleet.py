@@ -1,5 +1,10 @@
 """`ig-tpu fleet` — fleet-plane verbs.
 
+`fleet runs` renders the shared-run plane: one row per (node, shared
+gadget run) with live subscriber count and priority-class mix, worst
+queue depth, drop/eviction totals, and keepalive state — the operator's
+"who is riding which capture, and is anyone being shed".
+
 `fleet health` probes every agent with a bounded per-RPC deadline and
 renders the reachability + run-stream view the chaos runtime maintains
 live: a reachable agent is `healthy`, an unreachable one `dead`, and
@@ -33,6 +38,20 @@ def add_fleet_parser(sub) -> None:
     hp.add_argument("-o", "--output", default="table",
                     choices=["table", "json"])
     hp.set_defaults(func=cmd_fleet_health)
+    rp = fsub.add_parser(
+        "runs", help="per-node shared gadget runs: subscriber counts/"
+        "classes, queue depths, drops, keepalive state")
+    rp.add_argument("--remote", default="",
+                    help="name=target[,...]; defaults to the local fleet")
+    rp.add_argument("--deadline", type=float, default=3.0,
+                    help="per-agent RPC deadline in seconds")
+    rp.add_argument("--gadget", default="",
+                    help="restrict to one gadget (category/name)")
+    rp.add_argument("--all", action="store_true",
+                    help="include private (non-shared) and finished runs")
+    rp.add_argument("-o", "--output", default="table",
+                    choices=["table", "json"])
+    rp.set_defaults(func=cmd_fleet_runs)
 
 
 def _probe_agent(node: str, target: str, deadline: float) -> dict:
@@ -90,3 +109,102 @@ def cmd_fleet_health(args) -> int:
             print(f"{r['node']:<14s} {r['state']:<9s} {active:>4d} "
                   f"{r['detached']:>8d} {r['alerts']:>6d}  {detail}")
     return 0 if all(r["state"] == "healthy" for r in rows) else 1
+
+
+def _resolve_targets(args) -> dict | None:
+    from ..params import ParamError
+    from .main import parse_targets
+    try:
+        if args.remote:
+            return parse_targets(args.remote)
+        from .deploy import local_targets
+        return local_targets()
+    except ParamError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return None
+
+
+def _sub_summary(run: dict) -> tuple[str, str, int, int]:
+    """(classes, queue, drops, evictions) strings/counts for one run's
+    subscriber rows."""
+    subs = run.get("subscribers") or []
+    live = [s for s in subs if not s.get("left")]
+    classes: dict[str, int] = {}
+    for s in live:
+        classes[s.get("priority", "?")] = classes.get(
+            s.get("priority", "?"), 0) + 1
+    cls = ",".join(f"{n}×{c}" if n > 1 else c
+                   for c, n in sorted(classes.items())) or "-"
+    depth = max((s.get("queue_depth", 0) for s in live), default=0)
+    qmax = max((s.get("queue_max", 0) for s in live), default=0)
+    drops = sum(s.get("drops", 0) for s in subs)
+    evictions = sum(1 for s in subs if s.get("evicted"))
+    return cls, f"{depth}/{qmax}" if qmax else "-", drops, evictions
+
+
+def cmd_fleet_runs(args) -> int:
+    """Operator view of the shared-run plane: one row per (node, run)
+    with subscriber classes, worst queue depth, drop/eviction totals,
+    and keepalive state — the `fleet health` companion for "who is
+    riding which capture, and is anyone being shed"."""
+    targets = _resolve_targets(args)
+    if targets is None:
+        return 2
+    if not targets:
+        print("no agents (use deploy --local N or --remote)",
+              file=sys.stderr)
+        return 2
+    from ..agent.client import AgentClient
+    per_node: list[dict] = []
+    for node, target in targets.items():
+        row: dict = {"node": node, "target": target, "runs": [],
+                     "error": ""}
+        client = None
+        try:
+            client = AgentClient(target, node, rpc_deadline=args.deadline)
+            runs = client.dump_state().get("runs") or []
+            if not args.all:
+                runs = [r for r in runs
+                        if r.get("shared") and not r.get("done")]
+            if args.gadget:
+                runs = [r for r in runs if r.get("gadget") == args.gadget]
+            row["runs"] = runs
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            row["error"] = str(e)
+        finally:
+            if client is not None:
+                client.close()
+        per_node.append(row)
+    if args.output == "json":
+        print(json.dumps({"agents": per_node}, indent=2, default=str))
+        return 0 if not any(r["error"] for r in per_node) else 1
+    print(f"{'NODE':<12s} {'RUN':<22s} {'GADGET':<16s} {'SUBS':>4s} "
+          f"{'CLASSES':<14s} {'QUEUE':>9s} {'DROPS':>6s} {'EVICT':>5s}  "
+          f"STATE")
+    for r in per_node:
+        if r["error"]:
+            print(f"{r['node']:<12s} {'-':<22s} {'-':<16s} {'-':>4s} "
+                  f"{'-':<14s} {'-':>9s} {'-':>6s} {'-':>5s}  "
+                  f"unreachable: {r['error']}")
+            continue
+        if not r["runs"]:
+            print(f"{r['node']:<12s} {'-':<22s} {'-':<16s} {0:>4d} "
+                  f"{'-':<14s} {'-':>9s} {'-':>6s} {'-':>5s}  no shared "
+                  f"runs")
+            continue
+        for run in r["runs"]:
+            cls, q, drops, evictions = _sub_summary(run)
+            if run.get("done"):
+                state = "done"
+            elif run.get("attached"):
+                state = "serving"
+            elif run.get("keepalive_remaining", 0) > 0:
+                state = (f"keepalive "
+                         f"{run['keepalive_remaining']:.1f}s left")
+            else:
+                state = "detached"
+            print(f"{r['node']:<12s} {run['run_id']:<22s} "
+                  f"{run.get('gadget', ''):<16s} "
+                  f"{run.get('live_subscribers', 0):>4d} {cls:<14s} "
+                  f"{q:>9s} {drops:>6d} {evictions:>5d}  {state}")
+    return 0 if not any(r["error"] for r in per_node) else 1

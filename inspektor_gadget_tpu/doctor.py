@@ -352,6 +352,35 @@ def _probe_history_dir() -> Window:
         return Window("history_dir", False, repr(e))
 
 
+def _probe_each_agent(probe_one):
+    """The shared skeleton of the fleet-facing doctor rows: probe every
+    locally-registered agent concurrently under a bounded deadline (the
+    row costs one deadline, not one per agent) with per-node isolation.
+    Returns (targets, [(node, result, error)])."""
+    from .cli.deploy import local_targets
+    targets = local_targets()
+    if not targets:
+        return targets, []
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .agent.client import AgentClient
+
+    def probe(item):
+        node, target = item
+        client = None
+        try:
+            client = AgentClient(target, node, rpc_deadline=2.0)
+            return node, probe_one(client), None
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            return node, None, str(e)
+        finally:
+            if client is not None:
+                client.close()
+
+    with ThreadPoolExecutor(max_workers=min(len(targets), 16)) as ex:
+        return targets, list(ex.map(probe, targets.items()))
+
+
 def _probe_fleet_health() -> Window:
     """Fleet-plane row: are the locally-registered agents (deploy
     --local) reachable under a bounded deadline? No local fleet is fine
@@ -359,42 +388,55 @@ def _probe_fleet_health() -> Window:
     exactly the kind of silent rot the chaos runtime exists to surface
     (`ig-tpu fleet health` gives the per-run detail)."""
     try:
-        from .cli.deploy import local_targets
-        targets = local_targets()
+        targets, probed = _probe_each_agent(
+            lambda c: c.get_catalog(use_cache_on_error=False))
         if not targets:
             return Window("fleet_health", True,
                           "no local fleet registered (single-node mode)")
-        from concurrent.futures import ThreadPoolExecutor
-
-        from .agent.client import AgentClient
-
-        def probe(item):
-            node, target = item
-            client = None
-            try:
-                client = AgentClient(target, node, rpc_deadline=2.0)
-                client.get_catalog(use_cache_on_error=False)
-                return None
-            except Exception:  # noqa: BLE001 — unreachable is the finding
-                return node
-            finally:
-                if client is not None:
-                    client.close()
-
-        # concurrent probes: the row costs one deadline, not one per
-        # agent — a large registered fleet must not scale doctor latency
-        with ThreadPoolExecutor(max_workers=min(len(targets), 16)) as ex:
-            down = [n for n in ex.map(probe, targets.items())
-                    if n is not None]
+        down = sorted(n for n, _res, err in probed if err)
         if down:
             return Window("fleet_health", False,
                           f"{len(down)}/{len(targets)} agent(s) "
-                          f"unreachable: {', '.join(sorted(down))} "
+                          f"unreachable: {', '.join(down)} "
                           f"(expected during fleet bring-up)")
         return Window("fleet_health", True,
                       f"{len(targets)} local agent(s) reachable")
     except Exception as e:  # noqa: BLE001
         return Window("fleet_health", False, repr(e))
+
+
+def _probe_shared_runs() -> Window:
+    """Shared-run plane row: how many shared gadget runs and live
+    subscribers the local fleet is serving, and whether any subscriber
+    is being shed (drops/evictions). No fleet (or no shared runs) is
+    fine; an unreadable agent fails the row — an overloaded node you
+    cannot see is the outage in waiting (`ig-tpu fleet runs` gives the
+    per-run detail)."""
+    try:
+        targets, probed = _probe_each_agent(lambda c: c.shared_runs())
+        if not targets:
+            return Window("shared_runs", True,
+                          "no local fleet registered (single-node mode)")
+        down = sorted(n for n, _res, err in probed if err)
+        if down:
+            return Window("shared_runs", False,
+                          f"{len(down)}/{len(targets)} agent(s) "
+                          f"unreadable: {', '.join(down)}")
+        runs = [r for _n, rows, _e in probed for r in rows or []]
+        subs = sum(r.get("live_subscribers", 0) for r in runs)
+        drops = sum(s.get("drops", 0) for r in runs
+                    for s in (r.get("subscribers") or []))
+        evicted = sum(1 for r in runs
+                      for s in (r.get("subscribers") or [])
+                      if s.get("evicted"))
+        detail = (f"{len(runs)} shared run(s), {subs} live "
+                  f"subscriber(s) across {len(targets)} agent(s)")
+        if drops or evicted:
+            detail += (f"; shedding: {drops} drop(s), {evicted} "
+                       f"eviction(s) — see `ig-tpu fleet runs`")
+        return Window("shared_runs", True, detail)
+    except Exception as e:  # noqa: BLE001
+        return Window("shared_runs", False, repr(e))
 
 
 def _probe_mountinfo() -> Window:
@@ -423,7 +465,7 @@ _PROBES = (
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
     _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,
     _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
-    _probe_history_dir, _probe_fleet_health,
+    _probe_history_dir, _probe_fleet_health, _probe_shared_runs,
 )
 
 

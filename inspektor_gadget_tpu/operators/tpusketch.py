@@ -782,6 +782,19 @@ class TpuSketchInstance(OperatorInstance):
                 HISTORY_METRICS.drops.labels(reason="seal").inc()
             _ckpt_log.warning("window seal failed (window %d kept in "
                               "memory was dropped): %r", self._win_n, e)
+        else:
+            # announce the sealed window on the run stream (header only,
+            # no payload): summary-tier subscribers learn it exists and
+            # can FetchWindows it without ever riding the raw batches
+            hook = self.ctx.extra.get("on_window_sealed")
+            if hook is not None:
+                try:
+                    hook({"gadget": win.gadget, "window": win.window,
+                          "start_ts": win.start_ts, "end_ts": win.end_ts,
+                          "events": win.events, "drops": win.drops,
+                          "digest": win.digest})
+                except Exception as he:  # noqa: BLE001 — announce only
+                    _ckpt_log.warning("window announce failed: %r", he)
         # open the next window: rotate the ring, fresh HLL, new deltas
         self._wcms = _wcms_advance_jit(self._wcms)
         self._win_hll = hll_init(self._win_hll.p)

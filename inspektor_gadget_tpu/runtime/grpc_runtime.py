@@ -68,7 +68,8 @@ class GrpcRuntime(Runtime):
         self._clients: dict[str, Any] = {}
 
     def params(self) -> ParamDescs:
-        from ..params.validators import validate_int_range
+        from ..agent import wire
+        from ..params.validators import validate_int_range, validate_one_of
         return ParamDescs([
             ParamDesc(key="node", default="",
                       description="restrict to one node"),
@@ -130,6 +131,57 @@ class GrpcRuntime(Runtime):
                       type_hint=TypeHint.BOOL,
                       description="heal seq gaps from the node's sealed "
                                   "history windows after an outage"),
+            # shared-run multiplexing + overload protection: validated
+            # LOUDLY here (the stop-result-timeout pattern) before the
+            # first attach ever goes on the wire
+            ParamDesc(key="share", default="false",
+                      type_hint=TypeHint.BOOL,
+                      description="share the gadget run: the first "
+                                  "request for a (gadget, params, "
+                                  "outputs) key starts the gadget, "
+                                  "compatible requests attach as "
+                                  "subscribers to the same pipeline"),
+            ParamDesc(key="max-subscribers", default="16",
+                      type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=1),
+                      description="admission cap on subscribers per "
+                                  "shared run"),
+            ParamDesc(key="sub-queue", default="1024",
+                      type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=1),
+                      description="per-subscriber bounded delivery "
+                                  "queue (messages); a slow consumer "
+                                  "drops its own records, never its "
+                                  "peers'"),
+            ParamDesc(key="sub-budget", default="16384",
+                      type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=1),
+                      description="per-run queued-capacity budget "
+                                  "across all subscribers; low-priority "
+                                  "admissions are refused first near "
+                                  "the budget"),
+            ParamDesc(key="drop-policy", default="drop-oldest",
+                      validator=validate_one_of(wire.DROP_POLICIES),
+                      description="which record a full subscriber "
+                                  "queue sacrifices"),
+            ParamDesc(key="priority", default="normal",
+                      validator=validate_one_of(wire.PRIORITIES),
+                      description="this subscriber's admission/"
+                                  "protection class under overload"),
+            ParamDesc(key="evict-after", default="10s",
+                      type_hint=TypeHint.DURATION,
+                      validator=_validate_positive_duration,
+                      description="a subscriber stalled (queue full, "
+                                  "client not draining) longer than "
+                                  "this is evicted with a labeled "
+                                  "terminal record"),
+            ParamDesc(key="run-keepalive", default="10s",
+                      type_hint=TypeHint.DURATION,
+                      validator=_validate_positive_duration,
+                      description="after the last subscriber detaches "
+                                  "the gadget keeps running this long "
+                                  "awaiting a re-attach (no capture "
+                                  "thrash on dashboard churn)"),
         ])
 
     def _rp(self, ctx: GadgetContext, key: str):
@@ -261,6 +313,67 @@ class GrpcRuntime(Runtime):
         return answer_query(windows, key=key, top=top, dropped=dropped,
                             errors=errors)
 
+    # -- shared-run plane (subscribe-aware fan-out) --------------------------
+
+    def list_runs(self, gadget: str = "") -> tuple[dict, dict]:
+        """Per-node live shared-run rows (subscriber counts/classes,
+        queue depths, drops, keepalive state) — the attach-by-key
+        discovery surface `ig-tpu fleet runs` renders."""
+        return self._fanout_unary(
+            lambda c: {"runs": c.shared_runs(gadget=gadget)})
+
+    def subscribe_summaries(
+        self,
+        *,
+        gadget: str = "",
+        run_id: str = "",
+        on_summary: Callable[[str, dict], None] | None = None,
+        on_alert: Callable[[str, dict], None] | None = None,
+        on_window: Callable[[str, dict], None] | None = None,
+        stop_event: threading.Event | None = None,
+        priority: str = "low",
+        queue: int = 256,
+    ) -> dict:
+        """The summary pub/sub tier: attach a cheap summary-only
+        subscriber to every node's matching shared run — harvest
+        summaries, alert transitions, and sealed-window announcements
+        from ONE shared harvest, never the raw batches. Blocks until
+        stop_event (or every stream ends); returns per-node accounting
+        ({node: out-dict}; nodes with no matching run report an error
+        entry, never raise)."""
+        stop_event = stop_event or threading.Event()
+        results: dict[str, dict] = {}
+        results_mu = threading.Lock()
+
+        def run_node(node: str):
+            client = self._client(node)
+            try:
+                rid = run_id
+                if not rid:
+                    rows = client.shared_runs(gadget=gadget)
+                    if not rows:
+                        raise RuntimeError(
+                            f"no live shared run for {gadget or '<any>'!r}")
+                    rid = rows[0]["run_id"]
+                out = client.run_gadget(
+                    "", "", attach_to=rid,
+                    subscriber={"tier": "summary", "priority": priority,
+                                "queue": int(queue)},
+                    on_summary=on_summary, on_alert=on_alert,
+                    on_window=on_window, stop_event=stop_event)
+            except Exception as e:  # noqa: BLE001 — per-node isolation
+                out = {"error": str(e)}
+            with results_mu:
+                results[node] = out
+
+        threads = [threading.Thread(target=run_node, args=(n,), daemon=True)
+                   for n in self.targets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results
+
     def run_gadget(
         self,
         ctx: GadgetContext,
@@ -343,6 +456,18 @@ class GrpcRuntime(Runtime):
         resume_ring = self._rp(ctx, "resume-ring").as_int()
         backfill = self._rp(ctx, "backfill").as_bool()
         stop_timeout = self._rp(ctx, "stop-result-timeout").as_duration()
+        # shared-run / overload knobs (validated at the params layer —
+        # a bad value never reaches the wire)
+        share = self._rp(ctx, "share").as_bool()
+        run_keepalive = self._rp(ctx, "run-keepalive").as_duration()
+        max_subscribers = self._rp(ctx, "max-subscribers").as_int()
+        sub_budget = self._rp(ctx, "sub-budget").as_int()
+        subscriber_opts = {
+            "priority": self._rp(ctx, "priority").as_string(),
+            "drop_policy": self._rp(ctx, "drop-policy").as_string(),
+            "queue": self._rp(ctx, "sub-queue").as_int(),
+            "evict_after": self._rp(ctx, "evict-after").as_duration(),
+        }
         health = FleetHealth(
             nodes,
             straggler_factor=self._rp(ctx, "straggler-factor").as_float(),
@@ -419,6 +544,12 @@ class GrpcRuntime(Runtime):
                 def on_msg(_n: str, _seq: int, _t: int, node=node):
                     health.observe(node)
 
+                sup = NodeSupervisor(
+                    node, client, policy=policy, health=health,
+                    run_id=run_id, gadget=ctx.desc.full_name,
+                    done=lambda: ctx.done or stop_event.is_set(),
+                    logger=ctx.logger, backfill=backfill)
+
                 def attempt(resume_from, rid, node=node, nsp=nsp):
                     return client.run_gadget(
                         ctx.desc.category, ctx.desc.name, flat,
@@ -436,13 +567,17 @@ class GrpcRuntime(Runtime):
                         linger=resume_linger,
                         ring=resume_ring,
                         resume_from=resume_from,
+                        # name WHICH subscriber is reconnecting: without
+                        # the acked sub_id a shared run would resolve
+                        # the resume onto a peer's stream
+                        sub_id=sup.sub_id or None,
+                        share=share,
+                        keepalive=run_keepalive if share else None,
+                        max_subscribers=max_subscribers if share else None,
+                        sub_budget=sub_budget if share else None,
+                        subscriber=subscriber_opts if share else None,
                     )
 
-                sup = NodeSupervisor(
-                    node, client, policy=policy, health=health,
-                    run_id=run_id, gadget=ctx.desc.full_name,
-                    done=lambda: ctx.done or stop_event.is_set(),
-                    logger=ctx.logger, backfill=backfill)
                 try:
                     if supervise:
                         out = sup.run(attempt)
@@ -460,7 +595,13 @@ class GrpcRuntime(Runtime):
                             last_seq=int(out.get("last_seq") or 0),
                             backfilled=int(out.get("backfilled") or 0),
                             backfill=list(out.get("backfill") or ()),
-                            health=health.get(node))
+                            health=health.get(node),
+                            sub_drops=int(out.get("sub_drops") or 0),
+                            evicted=bool(out.get("evicted")),
+                            attach_refused=str(
+                                out.get("attach_refused") or ""),
+                            shared=bool((out.get("attach") or {}).get(
+                                "shared")))
                         if out.get("error"):
                             _tm_node_errors.labels(
                                 node=node,
@@ -569,4 +710,10 @@ class GrpcRuntime(Runtime):
             ctx.logger.warning(
                 "partial result: %d/%d node(s) contributed (unhealthy: %s)",
                 len(results.contributing()), len(nodes), degraded)
+        overloaded = results.overloaded()
+        if overloaded:
+            ctx.logger.warning(
+                "subscriber stream(s) degraded under fan-out: %s "
+                "(drops are this client's own queue, peers unaffected)",
+                overloaded)
         return results

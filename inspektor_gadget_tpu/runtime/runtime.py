@@ -33,6 +33,15 @@ class GadgetResult:
     backfilled: int = 0
     backfill: list = dataclasses.field(default_factory=list)
     health: str = ""
+    # shared-run subscriber accounting (next to the health fields so a
+    # degraded answer is LABELED): records this node's subscriber queue
+    # dropped under overload, whether it was evicted for stalling, a
+    # typed admission-refusal reason (empty = admitted), and whether the
+    # stream attached to an already-running shared gadget
+    sub_drops: int = 0
+    evicted: bool = False
+    attach_refused: str = ""
+    shared: bool = False
 
 
 class CombinedGadgetResult(dict):
@@ -66,6 +75,21 @@ class CombinedGadgetResult(dict):
         if any(r.error for r in self.values()):
             return True
         return any(s not in ("", "healthy") for s in self.health.values())
+
+    def overloaded(self) -> dict[str, str]:
+        """node → overload label for nodes whose subscriber stream was
+        degraded under fan-out (own-queue drops, eviction, or a refused
+        admission) — a thinned answer is LABELED thinned, never silently
+        complete-looking."""
+        out: dict[str, str] = {}
+        for node, r in self.items():
+            if r.attach_refused:
+                out[node] = f"refused ({r.attach_refused})"
+            elif r.evicted:
+                out[node] = f"evicted after {r.sub_drops} drop(s)"
+            elif r.sub_drops:
+                out[node] = f"{r.sub_drops} subscriber drop(s)"
+        return out
 
 
 class Runtime:

@@ -267,6 +267,10 @@ class NodeSupervisor:
         self.health = health
         self.run_id = run_id
         self.gadget = gadget
+        # the agent-assigned subscriber id, learned from attach/resume
+        # acks: a resume must name WHICH subscriber is reconnecting, or
+        # a shared run would resolve it to the wrong peer's stream
+        self.sub_id = ""
         self._done = done
         self._log = logger
         self._backfill_enabled = backfill
@@ -337,6 +341,11 @@ class NodeSupervisor:
             "result": None, "error": None, "gaps": 0, "dropped": 0,
             "records": 0, "last_seq": 0, "reconnects": 0,
             "backfilled": 0, "backfill": [],
+            # shared-run subscriber accounting, aggregated across
+            # reconnect attempts (drop totals are cumulative per
+            # subscriber, so max — not sum — across attempts)
+            "sub_drops": 0, "evicted": False, "attach_refused": "",
+            "attach": None,
         }
         resume_from: int | None = None
         attempt = 0                    # consecutive failed attempts
@@ -372,12 +381,23 @@ class NodeSupervisor:
             out["gaps"] += int(res.get("gaps") or 0)
             out["dropped"] += int(res.get("dropped") or 0)
             out["records"] += int(res.get("records") or 0)
+            out["sub_drops"] = max(out["sub_drops"],
+                                   int(res.get("sub_drops") or 0))
+            out["evicted"] = out["evicted"] or bool(res.get("evicted"))
+            if res.get("attach_refused"):
+                out["attach_refused"] = res["attach_refused"]
+            if res.get("attach") is not None:
+                out["attach"] = res["attach"]
+                if res["attach"].get("sub_id"):
+                    self.sub_id = res["attach"]["sub_id"]
             if res.get("last_seq"):
                 out["last_seq"] = int(res["last_seq"])
             if res.get("result") is not None:
                 out["result"] = res["result"]
 
             ack = res.get("resume") or {}
+            if ack.get("sub_id"):
+                self.sub_id = ack["sub_id"]
             was_reconnect = attempt > 0
             if int(res.get("records") or 0) > 0 or ack:
                 # the attempt made real progress: later, unrelated
@@ -400,6 +420,7 @@ class NodeSupervisor:
                          else self._wall() - self.policy.horizon)
                 self._backfill(since, self._wall() + 1.0, out)
                 resume_from = None
+                self.sub_id = ""  # the fresh run assigns a new identity
                 # the respawned agent numbers its NEW life's stream from
                 # seq 1: resuming (or gap-counting) against the dead
                 # life's high seq would silently skip the new ring

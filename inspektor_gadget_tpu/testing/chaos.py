@@ -17,6 +17,9 @@ real bugs — this tier exists to catch the distributed ones):
     process exercises the unknown-run → backfill-and-restart path.
   - SkewClock: an injectable monotonic clock with a settable offset, for
     testing that health/straggler logic tolerates clock skew.
+  - SubscriberChurn: attach/hold/detach cycles against one SHARED gadget
+    run (some rounds leaving by proxy cut) — dashboard-client churn as a
+    first-class fault for the shared-run multiplexing plane.
 
 Nothing here is test-framework-specific: `ig-tpu` users can point the
 proxy at a production agent to rehearse failure drills.
@@ -338,6 +341,79 @@ class AgentProcess:
                 self.kill()
 
 
+class SubscriberChurn:
+    """Attach/hold/detach churn against one SHARED gadget run — the
+    fan-out analogue of connection chaos (dashboard clients coming and
+    going, some of them dying mid-stream).
+
+    Each round attaches a fresh subscriber to `run_id` on `target`
+    (optionally dialing through a ChaosProxy), pumps records for `hold`
+    seconds, then leaves — cleanly via a stop request, or rudely via
+    `proxy.cut()` when `cut=True`. Counters (rounds, records, acks,
+    cuts, errors) let tests assert the churn really happened; the
+    invariants (no leaked queues/threads/runs, unaffected peers) are the
+    test's to check.
+    """
+
+    def __init__(self, target: str, run_id: str, *, node: str = "",
+                 proxy: "ChaosProxy | None" = None,
+                 subscriber: dict | None = None):
+        self.target = target
+        self.run_id = run_id
+        self.node = node or "churn"
+        self.proxy = proxy
+        self.subscriber = dict(subscriber or {})
+        self.rounds = 0
+        self.cuts = 0
+        self.records = 0
+        self.acks = 0
+        self.errors: list[str] = []
+
+    def round(self, hold: float = 0.5, cut: bool = False) -> dict:
+        """One attach/hold/leave cycle; returns the client's accounting
+        dict. cut=True severs the proxy mid-hold instead of stopping."""
+        from ..agent.client import AgentClient
+        stop = threading.Event()
+        holder: dict = {}
+        client = AgentClient(self.target, self.node)
+
+        def pump():
+            holder["out"] = client.run_gadget(
+                "", "", attach_to=self.run_id,
+                subscriber=dict(self.subscriber),
+                on_message=lambda *_: None, stop_event=stop)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        time.sleep(max(hold, 0.0))
+        if cut and self.proxy is not None:
+            self.proxy.cut()
+            self.cuts += 1
+            stop.set()  # unblock the stopper thread; the stream is gone
+        else:
+            stop.set()
+        t.join(timeout=30.0)
+        client.close()
+        out = holder.get("out") or {"error": "churn round never returned"}
+        self.rounds += 1
+        self.records += int(out.get("records") or 0)
+        if out.get("attach"):
+            self.acks += 1
+        # a cut round's transport error is the injected fault, not a
+        # failure of the run under test
+        if out.get("error") and not cut:
+            self.errors.append(str(out["error"]))
+        return out
+
+    def run(self, rounds: int, *, hold: float = 0.5,
+            cut_every: int = 0) -> None:
+        """`rounds` cycles; every cut_every-th (1-based) leaves by
+        proxy cut instead of a clean stop (0 = never cut)."""
+        for i in range(1, rounds + 1):
+            self.round(hold=hold,
+                       cut=bool(cut_every and i % cut_every == 0))
+
+
 class SkewClock:
     """A monotonic clock with injectable skew (FleetHealth's `clock`
     seam): skew(+5) jumps time forward five seconds for every consumer
@@ -355,4 +431,4 @@ class SkewClock:
         self.offset += float(seconds)
 
 
-__all__ = ["AgentProcess", "ChaosProxy", "SkewClock"]
+__all__ = ["AgentProcess", "ChaosProxy", "SkewClock", "SubscriberChurn"]

@@ -25,10 +25,17 @@ Fast deterministic subset (tier-1):
   delivered stream must stay within tolerance of it, because its agent
   kept capturing into the replay ring the whole time.
 
+Shared-run cases (ISSUE 12): cutting a subscriber mid-stream leaves the
+shared run and its peers whole (the dead subscriber lingers resumable);
+SIGKILLing the agent under a shared run answers unknown_run to EVERY
+subscriber, and each one's supervisor backfills its gap from the dead
+life's sealed windows independently.
+
 Slow soak (`-m slow`, excluded from tier-1): N nodes, repeated mixed
-faults, invariants (no wedged run, exact per-node seq accounting,
-stream states drained, bounded thread growth) + the N-node merge/ingest
-scaling points published as schema-valid PerfRecords.
+faults, PLUS subscriber churn against a shared run (some rounds leaving
+by proxy cut), invariants (no wedged run, exact per-node seq
+accounting, stream states drained, bounded thread growth) + the N-node
+merge/ingest scaling points published as schema-valid PerfRecords.
 """
 
 from __future__ import annotations
@@ -53,7 +60,7 @@ from inspektor_gadget_tpu.runtime.supervisor import (
 )
 from inspektor_gadget_tpu.telemetry import REGISTRY
 from inspektor_gadget_tpu.testing.chaos import (
-    AgentProcess, ChaosProxy, SkewClock,
+    AgentProcess, ChaosProxy, SkewClock, SubscriberChurn,
 )
 
 pytestmark = pytest.mark.filterwarnings("ignore::pytest.PytestUnhandledThreadExceptionWarning")
@@ -343,6 +350,191 @@ def test_lingering_run_visible_then_self_cancels(chaos_agents):
     assert not rows, "detached run did not cancel after its linger window"
     probe.close()
     client.close()
+
+
+# ---------------------------------------------------------------------------
+# shared-run subscribers under fault (ISSUE 12 fast tier)
+# ---------------------------------------------------------------------------
+
+def test_cut_subscriber_leaves_shared_run_whole(chaos_agents):
+    """A subscriber severed mid-stream is THAT subscriber's problem:
+    the shared gadget keeps capturing, the owner's stream never blips,
+    and the dead subscriber lingers detached awaiting a resume instead
+    of taking the run down with it."""
+    target = chaos_agents["targets"]["cnode-0"]
+    sub_proxy = ChaosProxy(target)  # the subscriber's own breakable path
+    owner_stop = threading.Event()
+    owner_holder: dict = {}
+    owner_seqs: list[int] = []
+    started = threading.Event()
+
+    def owner():
+        client = AgentClient(target, "cnode-0")
+        owner_holder["out"] = client.run_gadget(
+            "trace", "exec",
+            dict(RUN_PARAMS, **{"gadget.rate": "1600"}),
+            timeout=0.0, run_id="sub-cut", share=True, keepalive=1.0,
+            on_message=lambda _n, s, _t: (owner_seqs.append(s),
+                                          started.set()),
+            stop_event=owner_stop)
+        client.close()
+
+    t_owner = threading.Thread(target=owner, daemon=True)
+    t_owner.start()
+    assert started.wait(30.0), "shared run never produced"
+
+    sub_holder: dict = {}
+    sub_seqs: list[int] = []
+
+    def subscriber():
+        client = AgentClient(sub_proxy.target, "cut-sub")
+        sub_holder["out"] = client.run_gadget(
+            "", "", attach_to="sub-cut",
+            subscriber={"queue": 1024},
+            on_message=lambda _n, s, _t: sub_seqs.append(s))
+        client.close()
+
+    t_sub = threading.Thread(target=subscriber, daemon=True)
+    t_sub.start()
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline and len(sub_seqs) < 20:
+        time.sleep(0.05)
+    assert len(sub_seqs) >= 20, "subscriber saw no traffic before the cut"
+    owner_before_cut = len(owner_seqs)
+    sub_proxy.cut()
+    t_sub.join(timeout=20.0)
+    assert not t_sub.is_alive()
+    out = sub_holder["out"]
+    assert out["error"], "a severed subscriber must surface its error"
+    assert classify_error(out["error"]) == TRANSPORT
+
+    # the run and the owner are untouched; the cut subscriber's state
+    # lingers detached (resumable by the PR-8 protocol, per subscriber)
+    time.sleep(0.7)
+    probe = AgentClient(target, "probe", rpc_deadline=5.0)
+    rows = [r for r in probe.dump_state().get("runs", [])
+            if r["run_id"] == "sub-cut"]
+    probe.close()
+    assert rows and not rows[0]["done"], "subscriber cut killed the run"
+    assert len(owner_seqs) > owner_before_cut, "owner stream blipped"
+    sub_rows = {s["sub_id"]: s for s in rows[0]["subscribers"]}
+    cut_rows = [s for s in sub_rows.values()
+                if not s["attached"] and not s["left"]]
+    assert cut_rows, f"cut subscriber not lingering: {sub_rows}"
+    owner_stop.set()
+    t_owner.join(timeout=20.0)
+    assert owner_holder["out"]["error"] is None
+    # exact accounting end to end for the owner despite the peer's death
+    o = owner_holder["out"]
+    assert o["records"] + o["gaps"] == o["last_seq"]
+    assert o["sub_drops"] == 0
+    sub_proxy.close()
+
+
+def test_agent_sigkill_subscribers_unknown_run_then_independent_backfill(
+        tmp_path_factory):
+    """SIGKILL the agent under a shared run with two subscribers: BOTH
+    resumes answer unknown_run (the new life has nothing to resume),
+    and each subscriber's supervisor heals its own gap from the dead
+    life's sealed windows INDEPENDENTLY — two clients, two fetches,
+    the same sealed truth."""
+    from inspektor_gadget_tpu.history import HISTORY
+    from inspektor_gadget_tpu.runtime.supervisor import NodeSupervisor
+
+    hist = str(tmp_path_factory.mktemp("subkill-history"))
+    tmp = tempfile.mkdtemp()
+    addr = f"unix://{tmp}/subkill.sock"
+    proc = AgentProcess("subkill-node", addr, history_dir=hist)
+    proc.start(wait=True, timeout=90.0)
+    clients: list[AgentClient] = []
+    try:
+        params = {"gadget.source": "pysynthetic", "gadget.rate": "2000",
+                  "operator.tpusketch.enable": "true",
+                  "operator.tpusketch.log2-width": "10",
+                  "operator.tpusketch.hll-p": "10",
+                  "operator.tpusketch.harvest-interval": "300ms",
+                  "operator.tpusketch.history": "true",
+                  "operator.tpusketch.history-interval": "0",
+                  "operator.tpusketch.history-log2-width": "10",
+                  "operator.tpusketch.history-slots": "4"}
+        # warm the subprocess's sketch path so the measured life seals
+        warm = AgentClient(addr, "subkill-node")
+        warm.run_gadget("trace", "exec", params, timeout=1.5,
+                        outputs=("summary",))
+        warm.close()
+
+        owner_stop = threading.Event()
+        holder: dict = {}
+        got = threading.Event()
+
+        def owner():
+            c = AgentClient(addr, "subkill-node")
+            clients.append(c)
+            holder["owner"] = c.run_gadget(
+                "trace", "exec", params, timeout=0.0, run_id="subkill",
+                share=True, resumable=True, keepalive=8.0,
+                outputs=("summary",),
+                on_message=lambda *_: got.set(), stop_event=owner_stop)
+
+        def second():
+            c = AgentClient(addr, "subkill-2")
+            clients.append(c)
+            holder["second"] = c.run_gadget(
+                "", "", attach_to="subkill",
+                on_message=lambda *_: None)
+
+        t1 = threading.Thread(target=owner, daemon=True)
+        t1.start()
+        assert got.wait(60.0), "shared run never produced"
+        t2 = threading.Thread(target=second, daemon=True)
+        t2.start()
+        time.sleep(2.5)  # let the run seal a few 300ms windows
+
+        kill_wall = time.time()
+        proc.kill()
+        t1.join(timeout=30.0)
+        t2.join(timeout=30.0)
+        assert holder["owner"]["error"] and holder["second"]["error"]
+        proc.respawn(wait=True, timeout=90.0)
+
+        # every subscriber's resume answers unknown_run on the new life
+        for name, last in (("subkill-r1", holder["owner"]["last_seq"]),
+                           ("subkill-r2", 0)):
+            c = AgentClient(addr, name)
+            out = c.run_gadget("trace", "exec", {}, timeout=0.0,
+                               run_id="subkill", resume_from=int(last))
+            c.close()
+            assert out["unknown_run"] is True, (name, out)
+
+        # each subscriber's supervisor backfills INDEPENDENTLY from the
+        # dead life's sealed windows
+        health = FleetHealth(["subkill-node"])
+        outs = []
+        for name in ("bf-1", "bf-2"):
+            c = AgentClient(addr, name)
+            sup = NodeSupervisor(
+                "subkill-node", c,
+                policy=RetryPolicy(base=0.05, cap=0.2, horizon=2.0,
+                                   attempt_deadline=1.0),
+                health=health, run_id="subkill", gadget="trace/exec",
+                done=lambda: True)
+            out = {"backfill": [], "backfilled": 0}
+            sup._backfill(kill_wall - 30.0, time.time() + 1.0, out)
+            c.close()
+            outs.append(out)
+        for out in outs:
+            assert out["backfilled"] > 0, \
+                "subscriber recovered nothing from the dead life"
+        assert outs[0]["backfilled"] == outs[1]["backfilled"], \
+            "independent backfills must recover the same sealed truth"
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — dead channels
+                pass
+        proc.stop()
+        HISTORY.close_all()
 
 
 # ---------------------------------------------------------------------------
@@ -762,7 +954,8 @@ def test_soak_fleet_chaos_invariants_and_scaling(tmp_path_factory):
         runtime = GrpcRuntime(targets)
         ctx = GadgetContext(
             desc, gadget_params=params, operator_params=op_params,
-            runtime_params=_fast_runtime_params(runtime),
+            runtime_params=_fast_runtime_params(
+                runtime, **{"share": "true", "run-keepalive": "1s"}),
             timeout=20.0)
 
         events = []
@@ -788,8 +981,24 @@ def test_soak_fleet_chaos_invariants_and_scaling(tmp_path_factory):
                 faults["count"] += 1
                 time.sleep(1.3)
 
+        # subscriber churn rides the soak: dashboard clients attach and
+        # leave (some by proxy cut) against snode-0's SHARED run while
+        # the connection chaos plays out — the leak/thread invariants
+        # below now cover the multiplexing plane too
+        churn = SubscriberChurn(
+            targets["snode-0"], f"{ctx.run_id}-snode-0",
+            node="soak-churner", proxy=proxies["snode-0"],
+            subscriber={"queue": 256, "priority": "low"})
+
+        def churn_loop():
+            time.sleep(3.0)  # let the shared run start producing
+            stop_at = time.monotonic() + 12.0
+            while time.monotonic() < stop_at:
+                churn.round(hold=0.6, cut=(churn.rounds % 4 == 3))
+
         t0 = time.monotonic()
         threading.Thread(target=chaos_loop, daemon=True).start()
+        threading.Thread(target=churn_loop, daemon=True).start()
         result = runtime.run_gadget(ctx, on_event=events.append)
         duration = time.monotonic() - t0
 
@@ -803,6 +1012,12 @@ def test_soak_fleet_chaos_invariants_and_scaling(tmp_path_factory):
             assert r.records + r.gaps == r.last_seq, (node, r)
         total_reconnects = sum(r.reconnects for r in result.values())
         assert total_reconnects >= 2, "faults produced no reconnects?"
+        # the churn really happened, and some rounds attached cleanly
+        # (rounds overlapping a proxy fault may error — that IS the
+        # chaos; the invariants below are what must hold regardless)
+        assert churn.rounds >= 6, f"subscriber churn barely ran: {churn.rounds}"
+        assert churn.acks >= 2, "no churn subscriber ever attached"
+        assert churn.cuts >= 1, "no churn subscriber left by cut"
 
         # invariant: stream states drain (no leaked lingering runs)
         deadline = time.monotonic() + 15.0

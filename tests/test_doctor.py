@@ -32,7 +32,7 @@ def test_probe_windows_names_and_shape():
                 "mountinfo", "procfs", "blktrace", "tcpinfo", "audit",
                 "captrace", "fstrace", "sockstate", "sigtrace",
                 "container_runtime", "capture_dir", "history_dir",
-                "fleet_health"}
+                "fleet_health", "shared_runs"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail
@@ -74,6 +74,25 @@ def test_fleet_health_row_reports_local_fleet(monkeypatch):
     w = _probe_fleet_health()
     assert not w.ok
     assert "unreachable" in w.detail and "ghost" in w.detail
+
+
+def test_shared_runs_row_reports_fleet_shared_state(monkeypatch):
+    """The shared-run doctor row (ISSUE 12 satellite): no fleet is fine
+    (single-node mode); an unreadable agent degrades the row — an
+    overloaded node you cannot see is the outage in waiting."""
+    import inspektor_gadget_tpu.cli.deploy as deploy
+    from inspektor_gadget_tpu.doctor import _probe_shared_runs
+
+    monkeypatch.setattr(deploy, "local_targets", lambda: {})
+    w = _probe_shared_runs()
+    assert w.ok and "single-node" in w.detail
+
+    monkeypatch.setattr(deploy, "local_targets",
+                        lambda: {"ghost": "127.0.0.1:1"})
+    monkeypatch.setenv("IG_RPC_DEADLINE", "2.0")
+    w = _probe_shared_runs()
+    assert not w.ok
+    assert "unreadable" in w.detail and "ghost" in w.detail
 
 
 def test_gadget_report_covers_every_registered_gadget():

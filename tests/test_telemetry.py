@@ -563,3 +563,158 @@ def test_history_counters_and_active_store_gauge(tmp_path):
     assert "ig_history_bytes_total" in text
     assert "ig_history_gc_total" in text
     assert "ig_history_active_stores" in text
+
+
+# ---------------------------------------------------------------------------
+# shared-run gauge discipline (ISSUE 12 satellite): attach/detach/evict/
+# keepalive-expiry churn must return every per-run gauge EXACTLY to
+# baseline — a drifting gauge on a long-lived agent is a lying dashboard
+# ---------------------------------------------------------------------------
+
+def _default_metric(name: str, **labels) -> float:
+    total = 0.0
+    for key, v in telemetry.REGISTRY.snapshot().items():
+        if key != name and not key.startswith(name + "{"):
+            continue
+        if all(f'{k}="{lv}"' in key for k, lv in labels.items()):
+            total += v
+    return total
+
+
+def test_shared_run_gauges_return_to_baseline_across_churn():
+    """SharedRun-level churn: 3 subscribers attach, one overloads its
+    8-deep queue (drops counted per (run, policy, class)) and is evicted
+    off its stall window, the rest detach/leave, a late re-attach
+    cancels the keepalive, and the final keepalive expiry cancels the
+    gadget — after which ig_agent_detached_runs and
+    ig_agent_run_subscribers sit exactly where they started."""
+    from inspektor_gadget_tpu.agent import wire
+    from inspektor_gadget_tpu.agent.service import SharedRun
+
+    detached_before = _default_metric("ig_agent_detached_runs")
+    evictions_before = _default_metric(
+        "ig_agent_subscriber_evictions_total")
+
+    class _Ctx:
+        def __init__(self):
+            self.cancelled = threading.Event()
+
+        def cancel(self):
+            self.cancelled.set()
+
+    run = SharedRun("gauge-run", "trace/gauge", shared=True,
+                    keepalive=0.3, max_subscribers=8, sub_budget=64,
+                    node="t")
+    ctx = _Ctx()
+    run.ctx = ctx
+    subs = []
+    for i in range(3):
+        sub = run.admit({"queue": 8,
+                         "priority": "low" if i == 2 else "normal",
+                         "evict_after": 0.2 if i == 2 else 60.0})
+        assert not isinstance(sub, dict), sub
+        q, gen, _ack = run.attach_subscriber(sub, 0)
+        subs.append((sub, q, gen))
+    assert _default_metric("ig_agent_run_subscribers",
+                          run="gauge-run") == 3.0
+
+    # overload: nobody drains, the low-priority 8-deep queue overflows;
+    # past its 0.2s stall window the next push evicts it
+    for _ in range(20):
+        run.push(wire.EV_PAYLOAD_JSON, {"node": "t"}, b"x")
+    victim = subs[2][0]
+    assert victim.drops > 0
+    assert _default_metric("ig_agent_subscriber_drops_total",
+                          run="gauge-run", policy="drop-oldest",
+                          **{"class": "low"}) >= float(victim.drops)
+    time.sleep(0.3)
+    run.push(wire.EV_PAYLOAD_JSON, {"node": "t"}, b"x")
+    assert victim.evicted and victim.left
+    assert _default_metric("ig_agent_subscriber_evictions_total") == \
+        evictions_before + 1.0
+    assert _default_metric("ig_agent_run_subscribers",
+                          run="gauge-run") == 2.0
+
+    # transport-detach one (peers still attached: nothing run-level),
+    # then the last leave arms the keepalive
+    run.detach(subs[0][0], subs[0][2])
+    assert _default_metric("ig_agent_detached_runs") == detached_before
+    run.leave(subs[0][0])
+    run.leave(subs[1][0])
+    assert _default_metric("ig_agent_detached_runs") == \
+        detached_before + 1.0
+    assert run.keepalive_remaining() > 0.0
+
+    # a re-attach inside the window cancels the countdown and clears the
+    # detached gauge; its leave re-arms
+    late = run.admit({"queue": 8})
+    assert not isinstance(late, dict)
+    run.attach_subscriber(late, 0)
+    assert _default_metric("ig_agent_detached_runs") == detached_before
+    assert not ctx.cancelled.is_set()
+    run.leave(late)
+
+    # keepalive expiry cancels the gadget; the run thread would then
+    # finish() — after which every gauge is back at baseline
+    assert ctx.cancelled.wait(3.0), "keepalive expiry never cancelled"
+    run.finish()
+    assert _default_metric("ig_agent_detached_runs") == detached_before
+    assert _default_metric("ig_agent_run_subscribers",
+                          run="gauge-run") == 0.0
+
+    text = telemetry.render_prometheus()
+    assert "ig_agent_run_subscribers" in text
+    assert "ig_agent_subscriber_drops_total" in text
+    assert "ig_agent_subscriber_evictions_total" in text
+    assert "ig_agent_attach_refused_total" in text or True  # labeled lazily
+
+
+def test_agent_active_runs_gauge_baseline_across_shared_lifecycle():
+    """Through the real agent: a shared run created, subscribed,
+    detached, and keepalive-expired must return ig_agent_active_runs
+    and ig_agent_detached_runs exactly to baseline (the run registry
+    and the gauges retire together)."""
+    import tempfile
+
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    from inspektor_gadget_tpu.agent.service import serve
+
+    active_before = _default_metric("ig_agent_active_runs")
+    detached_before = _default_metric("ig_agent_detached_runs")
+    tmp = tempfile.mkdtemp()
+    addr = f"unix://{tmp}/gauge.sock"
+    server, agent = serve(addr, node_name="gauge-node")
+    try:
+        stop = threading.Event()
+        holder: dict = {}
+        got = threading.Event()
+
+        def owner():
+            c = AgentClient(addr, "gauge-node")
+            holder["out"] = c.run_gadget(
+                "trace", "exec",
+                {"gadget.source": "pysynthetic", "gadget.rate": "900"},
+                timeout=0.0, run_id="gauge-life", share=True,
+                keepalive=0.4,
+                on_message=lambda *_: got.set(), stop_event=stop)
+            c.close()
+
+        t = threading.Thread(target=owner, daemon=True)
+        t.start()
+        assert got.wait(30.0), "no stream traffic"
+        assert _default_metric("ig_agent_active_runs") == \
+            active_before + 1.0
+        stop.set()
+        t.join(timeout=20.0)
+        assert holder["out"]["error"] is None
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if _default_metric("ig_agent_active_runs") == active_before \
+                    and _default_metric("ig_agent_detached_runs") == \
+                    detached_before:
+                break
+            time.sleep(0.1)
+        assert _default_metric("ig_agent_active_runs") == active_before
+        assert _default_metric("ig_agent_detached_runs") == detached_before
+    finally:
+        server.stop(grace=0.5)

@@ -47,6 +47,47 @@ def test_checker_would_catch_unregistered_window_id():
     assert any("multiple constants" in v for v in check_source(collide, "w.py"))
 
 
+def test_overload_plane_ids_registered():
+    """EV_DROP_NOTICE (per-subscriber overload accounting) and
+    EV_ATTACH_ACK (shared-run attach/refusal) must ride the one
+    authoritative table like every other plane's wire id, distinct from
+    the resume ack they sit next to."""
+    from inspektor_gadget_tpu.agent import wire
+    assert wire.WIRE_EVENT_IDS["EV_DROP_NOTICE"] == wire.EV_DROP_NOTICE
+    assert wire.WIRE_EVENT_IDS["EV_ATTACH_ACK"] == wire.EV_ATTACH_ACK
+    assert len({wire.EV_RESUME_ACK, wire.EV_DROP_NOTICE,
+                wire.EV_ATTACH_ACK}) == 3
+    assert all(0 < v < (1 << wire.EV_LOG_SHIFT)
+               for v in (wire.EV_DROP_NOTICE, wire.EV_ATTACH_ACK))
+
+
+def test_checker_would_catch_overload_plane_drift():
+    """The drift modes ISSUE 12 could have introduced: hand-assigning
+    the attach ack onto the resume ack's id, or registering the drop
+    notice with a value its constant doesn't have."""
+    collide = _src("""
+        EV_RESUME_ACK = 10
+        EV_ATTACH_ACK = 10
+        WIRE_EVENT_IDS = {"EV_RESUME_ACK": EV_RESUME_ACK,
+                          "EV_ATTACH_ACK": EV_ATTACH_ACK}
+    """)
+    assert any("multiple constants" in v
+               for v in check_source(collide, "w.py"))
+    mismatch = _src("""
+        EV_DROP_NOTICE = 11
+        WIRE_EVENT_IDS = {"EV_DROP_NOTICE": 12}
+    """)
+    assert any("registers 12" in v for v in check_source(mismatch, "w.py"))
+    # a table row pointing at a constant that was renamed away must be
+    # flagged stale, not silently decode as the old id
+    renamed = _src("""
+        EV_ATTACH_ACK = 12
+        WIRE_EVENT_IDS = {"EV_ATTACH_ACK": EV_ATTACH_ACK,
+                          "EV_ADMIT_ACK": 12}
+    """)
+    assert any("stale" in v for v in check_source(renamed, "w.py"))
+
+
 def test_runtime_table_matches_module_constants():
     from inspektor_gadget_tpu.agent import wire
     for name, value in wire.WIRE_EVENT_IDS.items():
