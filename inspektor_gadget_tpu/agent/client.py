@@ -512,6 +512,49 @@ class AgentClient:
                 return frames, losses
             offset = int(h.get("next_offset", offset + len(got)))
 
+    def query_windows(self, *, gadget: str = "",
+                      start_ts: float | None = None,
+                      end_ts: float | None = None,
+                      start_seq: int | None = None,
+                      end_seq: int | None = None,
+                      key: str | None = None) -> dict:
+        """Query pushdown: the agent folds the range/slice query
+        node-side and returns ONE merged window plus accounting —
+        fleet-query wire cost O(nodes), not O(windows). Raises
+        grpc.RpcError UNIMPLEMENTED against pre-pushdown agents (the
+        runtime falls back to list+fetch per node) and RuntimeError on
+        a typed server-side refusal."""
+        from ..history import decode_frames, unpack_frames
+        method = self.channel.unary_unary(
+            "/igtpu.GadgetManager/QueryWindows",
+            request_serializer=wire.identity_serializer,
+            response_deserializer=wire.identity_deserializer,
+        )
+        reply = method(wire.encode_msg({
+            "gadget": gadget, "start_ts": start_ts, "end_ts": end_ts,
+            "start_seq": start_seq, "end_seq": end_seq, "key": key}),
+            timeout=self.rpc_deadline)
+        h, payload = wire.decode_msg(reply)
+        if h.get("error"):
+            raise RuntimeError(h["error"])
+        frames, dropped_bytes = unpack_frames(payload)
+        wins = decode_frames(frames)
+        losses = list(h.get("losses") or [])
+        if dropped_bytes:
+            losses.append({"store": "<query>", "segment": "<reply>",
+                           "offset": 0, "dropped_bytes": dropped_bytes,
+                           "reason": "truncated query reply"})
+        return {
+            "node": h.get("node", self.node_name),
+            "window": wins[0] if wins else None,
+            "folded": int(h.get("folded", 0)),
+            "levels": {int(k): int(v)
+                       for k, v in (h.get("levels") or {}).items()},
+            "torn": int(h.get("torn", 0)),
+            "dropped": list(h.get("dropped") or []),
+            "losses": losses,
+        }
+
     # -- Trace resources (ref: utils/trace.go:340-848 CreateTrace/
     #    SetTraceOperation/getTraceListFromOptions, over agent RPCs) --------
 

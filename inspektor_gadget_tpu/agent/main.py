@@ -45,6 +45,23 @@ def main(argv=None) -> int:
                          "history (tpusketch --history true; served via "
                          "ListWindows/FetchWindows); default "
                          "$IG_HISTORY_DIR or ~/.ig-tpu/history")
+    from ..history.lifecycle import DEFAULT_SCHEDULE
+    sp.add_argument("--history-compact", action="store_true",
+                    help="run the tiered-history compaction engine in "
+                         "the background: aged sealed windows merge into "
+                         "coarser super-windows per --history-schedule")
+    sp.add_argument("--history-schedule", default=DEFAULT_SCHEDULE,
+                    help="resolution schedule res@horizon[,...]; the "
+                         "last horizon must be inf (validated at startup)")
+    sp.add_argument("--history-compact-interval", type=float, default=60.0,
+                    help="seconds between background compaction passes")
+    sp.add_argument("--history-archive-dir", default="",
+                    help="offload fully-compacted cold history segments "
+                         "to this archive root (manifest-driven "
+                         "rehydration serves queries over them)")
+    sp.add_argument("--history-archive-cache-bytes", type=int,
+                    default=64 << 20,
+                    help="rehydration cache budget (LRU by bytes)")
     sp.add_argument("--metrics-addr", default="",
                     help="serve Prometheus text metrics on host:port "
                          "(e.g. :9100); off by default")
@@ -202,6 +219,17 @@ def _serve_loop(args) -> int:
     if args.history_dir:
         from ..history import HISTORY
         HISTORY.set_base_dir(args.history_dir)
+    if args.history_archive_dir:
+        from ..history import HISTORY
+        HISTORY.set_archive(args.history_archive_dir,
+                            args.history_archive_cache_bytes)
+    compactor = None
+    if args.history_compact:
+        # schedule validated LOUDLY before the agent serves: a bad
+        # retention policy must fail startup, not eat history later
+        from ..history import CompactionEngine
+        compactor = CompactionEngine(args.history_schedule)
+        compactor.start_background(args.history_compact_interval)
     # bind BEFORE installing hooks: a prestart config pointing at a socket
     # nobody serves stalls every container creation on the host
     server, _agent = serve(args.listen, node_name=args.node_name,
@@ -285,6 +313,8 @@ def _serve_loop(args) -> int:
         from ..capture import RECORDINGS
         RECORDINGS.stop_all()
         # same for history stores: close seals active window segments
+        if compactor is not None:
+            compactor.stop()
         from ..history import HISTORY
         HISTORY.close_all()
         if installer is not None:

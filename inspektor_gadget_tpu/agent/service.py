@@ -1565,8 +1565,18 @@ class AgentServer:
                 validate_store_name(gadget.replace("/", "-"))
             except ValueError as e:
                 return wire.encode_msg({"error": str(e)})
-        offset = max(int(h.get("offset", 0)), 0)
-        max_bytes = min(max(int(h.get("max_bytes", 1 << 20)), 1), 2 << 20)
+        try:
+            # pagination contract: ANY offset is well-formed — one past
+            # the last match (offset == N) or far beyond (offset > N)
+            # returns an EMPTY ok reply with eof=true, never an error
+            # (the client's drain loop lands on exactly N after a full
+            # chunk, and a store shrunk by GC/compaction between chunks
+            # can leave it beyond)
+            offset = max(int(h.get("offset", 0)), 0)
+            max_bytes = min(max(int(h.get("max_bytes", 1 << 20)), 1),
+                            2 << 20)
+        except (TypeError, ValueError) as e:
+            return wire.encode_msg({"error": f"bad offset/max_bytes: {e}"})
         losses: list = []
         picked: list[tuple[dict, bytes]] = []
         size = 0
@@ -1595,6 +1605,52 @@ class AgentServer:
              # losses, and repeating them would multiply the accounting
              "losses": losses if offset == 0 else []},
             pack_frames(picked))
+
+    def query_windows(self, request: bytes, context) -> bytes:
+        """Query pushdown (history/lifecycle plane): fold the
+        (time-range, seq-range, key) query NODE-SIDE — prune, decode,
+        dedupe across tiers, merge — and ship back ONE merged window
+        plus accounting (windows folded, levels consulted, torn/dropped
+        counts). Fleet-query wire cost becomes O(nodes) instead of
+        O(windows): the raw windows never leave the node."""
+        _tm_rpc.labels(method="QueryWindows").inc()
+        h, _ = wire.decode_msg(request)
+        from ..history import (HISTORY, decode_frames, dedupe_compacted,
+                               encode_window, level_counts, merge_windows,
+                               merged_to_sealed, pack_frames,
+                               validate_store_name)
+        gadget = h.get("gadget", "") or ""
+        if gadget:
+            try:
+                validate_store_name(gadget.replace("/", "-"))
+            except ValueError as e:
+                return wire.encode_msg({"error": str(e)})
+        losses: list = []
+        try:
+            frames = list(HISTORY.fetch_windows(
+                gadget=gadget, losses=losses, node=self.node_name,
+                **self._window_range(h)))
+        except (OSError, ValueError) as e:
+            return wire.encode_msg({"error": str(e)})
+        kept, notes = dedupe_compacted(decode_frames(frames))
+        merged = merge_windows(kept)
+        levels = level_counts(kept)
+        payload = b""
+        if merged.windows:
+            sw = merged_to_sealed(
+                merged, gadget=gadget or kept[0].gadget,
+                node=self.node_name, level=max(levels, default=0),
+                window=0, run_id="query")
+            payload = pack_frames([encode_window(sw)])
+        return wire.encode_msg({
+            "ok": True,
+            "node": self.node_name,
+            "folded": merged.windows,
+            "levels": {str(k): v for k, v in sorted(levels.items())},
+            "torn": len(losses),
+            "dropped": list(merged.skipped) + notes,
+            "losses": losses,
+        }, payload)
 
     # -- dump-state debug RPC (ref: gadgettracermanager.go DumpState :204) --
 
@@ -1652,6 +1708,18 @@ class AgentServer:
                 ]
         except Exception as e:
             dump_error = f"container dump failed: {e!r}"
+        # the node's history-tier footprint rides the debug dump too:
+        # `ig-tpu history tiers --remote` and the doctor history_tiers
+        # row read windows/bytes per compaction level + archive usage
+        # without a store-walking RPC of their own
+        history_tiers: dict = {}
+        try:
+            from ..history import HISTORY
+            # TTL-cached: fleet health/runs/alerts all poll DumpState,
+            # and the tier walk reads every store frame
+            history_tiers = HISTORY.tier_stats(ttl=10.0)
+        except Exception as e:  # noqa: BLE001 — debug dump stays best-effort
+            history_tiers = {"error": repr(e)}
         # the node's alert table rides the same debug dump, so a remote
         # `ig-tpu alerts list` can read every agent's active alerts
         from ..alerts import ACTIVE as active_alerts
@@ -1659,6 +1727,7 @@ class AgentServer:
                "runs": run_rows,
                "containers": containers,
                "alerts": active_alerts.all(),
+               "history_tiers": history_tiers,
                # CRD-path state rides the same debug dump (the reference's
                # daemon dumps its trace list alongside containers)
                "traces": [{"name": t["metadata"]["name"],
@@ -1742,6 +1811,8 @@ def serve(address: str = "unix:///tmp/igtpu-agent.sock",
         "ListWindows": _method(agent.list_windows, "unary", "ListWindows"),
         "FetchWindows": _method(agent.fetch_windows, "unary",
                                 "FetchWindows"),
+        "QueryWindows": _method(agent.query_windows, "unary",
+                                "QueryWindows"),
         "ApplyTrace": _method(agent.apply_trace, "unary", "ApplyTrace"),
         "GetTrace": _method(agent.get_trace, "unary", "GetTrace"),
         "ListTraces": _method(agent.list_traces, "unary", "ListTraces"),

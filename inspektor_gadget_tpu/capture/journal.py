@@ -348,9 +348,19 @@ class JournalWriter:
         The active segment and the index rows of surviving segments are
         untouched; GC'd rows stay in the index flagged nowhere — readers
         treat a missing sealed file as GC'd history, not corruption."""
-        sealed = [s for s in _list_segments(self.path)
-                  if _seg_number(s) < self._seg_n]
-        total = sum(os.path.getsize(s) for s in sealed) + self._seg_bytes
+        sealed = []
+        total = self._seg_bytes
+        for s in _list_segments(self.path):
+            if _seg_number(s) >= self._seg_n:
+                continue
+            try:
+                # a compaction/archive pass (history stores share this
+                # writer machinery) may GC a sealed segment between the
+                # listing and the stat — treat it as already gone
+                total += os.path.getsize(s)
+            except OSError:
+                continue
+            sealed.append(s)
         removed = 0
         for s in sealed:
             over_bytes = (self.retention_bytes > 0

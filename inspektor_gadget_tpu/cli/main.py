@@ -148,6 +148,9 @@ def build_parser() -> argparse.ArgumentParser:
     from .query import add_query_parser
     add_query_parser(sub)
 
+    from .history import add_history_parser
+    add_history_parser(sub)
+
     # fleet robustness plane: per-agent health + run-stream attach states
     from .fleet import add_fleet_parser
     add_fleet_parser(sub)

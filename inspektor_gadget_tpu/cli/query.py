@@ -91,7 +91,7 @@ def cmd_query(args) -> int:
                    f"{loss.get('dropped_bytes', 0)} bytes)"
                    for loss in losses]
         answer = answer_query(decode_frames(frames), key=key, top=args.top,
-                              dropped=dropped)
+                              dropped=dropped, paths={"local": "local"})
 
     if args.output == "json":
         print(json.dumps(answer.to_dict(), indent=2, default=str))
@@ -110,6 +110,21 @@ def _print_answer(answer, *, key: str | None, show_slices: bool,
     nodes = ",".join(answer.nodes) or "local"
     print(f"{answer.windows} window(s) [{nodes}] "
           f"ts {answer.start_ts:.3f} .. {answer.end_ts:.3f}")
+    compacted = answer.compacted_windows()
+    if compacted:
+        # resolution loss must be visible, not a surprise: part of this
+        # answer came from compacted (coarser) super-windows
+        lvl_s = ", ".join(f"L{lvl}×{n}"
+                          for lvl, n in sorted(answer.levels.items())
+                          if lvl > 0)
+        print(f"note: {compacted} of {answer.windows} window(s) were "
+              f"compacted to coarser resolution ({lvl_s}) — time "
+              "granularity inside those ranges is the tier's, not the "
+              "native seal interval")
+    fallback = sorted(n for n, p in answer.paths.items() if p == "fetch")
+    if fallback:
+        print(f"note: node(s) {', '.join(fallback)} answered via "
+              "list+fetch fallback (pre-pushdown agent)")
     print(f"events={answer.events:,} drops={answer.drops} "
           f"distinct≈{answer.distinct:,.0f} "
           f"entropy={answer.entropy_bits:.2f}b")

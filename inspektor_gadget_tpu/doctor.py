@@ -352,6 +352,35 @@ def _probe_history_dir() -> Window:
         return Window("history_dir", False, repr(e))
 
 
+def _probe_history_tiers() -> Window:
+    """Tier-plane row: how the node's history footprint is distributed
+    across compaction levels and the archive tier. An empty store is
+    fine (nothing sealed yet); the row fails only when the tier walk
+    itself breaks — a store you cannot account is a retention policy
+    you cannot trust."""
+    try:
+        from .history import HISTORY
+        tiers = HISTORY.tier_stats()
+        levels = tiers.get("levels") or {}
+        if not tiers.get("stores"):
+            return Window("history_tiers", True,
+                          "no history stores yet (nothing sealed)")
+        lvl_s = ", ".join(
+            f"L{lvl}: {row['windows']}w/{row['bytes'] / (1 << 20):.1f}MiB"
+            for lvl, row in levels.items()) or "no windows"
+        arch = tiers.get("archived") or {}
+        detail = (f"{tiers['stores']} store(s), {lvl_s}")
+        if arch.get("segments"):
+            cache = tiers.get("archive_cache") or {}
+            detail += (f"; archived {arch['segments']} segment(s)/"
+                       f"{arch['bytes'] / (1 << 20):.1f}MiB "
+                       f"(cache {cache.get('hits', 0)}h/"
+                       f"{cache.get('misses', 0)}m)")
+        return Window("history_tiers", True, detail)
+    except Exception as e:  # noqa: BLE001
+        return Window("history_tiers", False, repr(e))
+
+
 def _probe_each_agent(probe_one):
     """The shared skeleton of the fleet-facing doctor rows: probe every
     locally-registered agent concurrently under a bounded deadline (the
@@ -465,7 +494,8 @@ _PROBES = (
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
     _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,
     _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
-    _probe_history_dir, _probe_fleet_health, _probe_shared_runs,
+    _probe_history_dir, _probe_history_tiers, _probe_fleet_health,
+    _probe_shared_runs,
 )
 
 

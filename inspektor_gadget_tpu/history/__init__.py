@@ -11,7 +11,29 @@ index-overlapping windows client-side — `ig-tpu query` answers
 "cardinality of tenant X, 2–3pm, across nodes" from sealed state.
 """
 
-from .query import QueryAnswer, answer_query, decode_frames, pack_frames, unpack_frames
+from .archive import (
+    ARCHIVE_MANIFEST,
+    ARCHIVE_SCHEMA,
+    ArchiveBackend,
+    ArchiveTier,
+    FilesystemArchive,
+)
+from .lifecycle import (
+    DEFAULT_SCHEDULE,
+    CompactionEngine,
+    ScheduleLevel,
+    parse_schedule,
+    validate_schedule,
+)
+from .query import (
+    QueryAnswer,
+    answer_query,
+    decode_frames,
+    dedupe_compacted,
+    level_counts,
+    pack_frames,
+    unpack_frames,
+)
 from .store import (
     HISTORY,
     HISTORY_METRICS,
@@ -29,13 +51,19 @@ from .window import (
     encode_window,
     header_overlaps,
     merge_windows,
+    merged_to_sealed,
+    provenance_row,
     window_digest,
 )
 
 __all__ = [
-    "HISTORY", "HISTORY_METRICS", "HISTORY_SCHEMA", "HistoryStore",
-    "MergedWindows", "QueryAnswer", "SealedWindow", "SliceSketch",
+    "ARCHIVE_MANIFEST", "ARCHIVE_SCHEMA", "ArchiveBackend", "ArchiveTier",
+    "CompactionEngine", "DEFAULT_SCHEDULE", "FilesystemArchive", "HISTORY",
+    "HISTORY_METRICS", "HISTORY_SCHEMA", "HistoryStore", "MergedWindows",
+    "QueryAnswer", "ScheduleLevel", "SealedWindow", "SliceSketch",
     "WINDOW_SCHEMA", "answer_query", "decode_frames", "decode_window",
-    "encode_window", "header_overlaps", "history_base_dir", "merge_windows",
-    "pack_frames", "unpack_frames", "validate_store_name", "window_digest",
+    "dedupe_compacted", "encode_window", "header_overlaps",
+    "history_base_dir", "level_counts", "merge_windows", "merged_to_sealed",
+    "pack_frames", "parse_schedule", "provenance_row", "unpack_frames",
+    "validate_schedule", "validate_store_name", "window_digest",
 ]

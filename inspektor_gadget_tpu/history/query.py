@@ -72,6 +72,19 @@ class QueryAnswer:
     slices: dict[str, dict]
     dropped_windows: list[str]      # merges refused (geometry) + torn tails
     errors: dict[str, str]          # per-node fetch errors (never fatal)
+    # tier accounting (history/lifecycle.py): windows folded per
+    # compaction level — a nonzero level>0 count means part of this
+    # answer came from compacted (coarser-resolution) super-windows,
+    # and the CLI says so rather than surprising the user with
+    # resolution loss. paths records HOW each node answered:
+    # "pushdown" (QueryWindows folded node-side), "fetch" (list+fetch
+    # fallback for old agents), or "local".
+    levels: dict[int, int] = dataclasses.field(default_factory=dict)
+    paths: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def compacted_windows(self) -> int:
+        """How many folded windows were coarser than native resolution."""
+        return sum(n for lvl, n in self.levels.items() if lvl > 0)
 
     def to_dict(self) -> dict:
         return {
@@ -89,18 +102,74 @@ class QueryAnswer:
             "slices": self.slices,
             "dropped_windows": self.dropped_windows,
             "errors": self.errors,
+            "levels": {str(k): v for k, v in sorted(self.levels.items())},
+            "compacted_windows": self.compacted_windows(),
+            "paths": dict(self.paths),
         }
+
+
+def dedupe_compacted(windows: Iterable[SealedWindow]
+                     ) -> tuple[list[SealedWindow], list[str]]:
+    """Exactly-once coverage across tiers: drop (1) any window whose
+    digest a present super-window's compacted_from lists — a crash
+    between super-window append and source GC leaves both on disk, and
+    merging both would double-count — and (2) exact duplicate digests.
+    Returns (kept, notes); every drop is reported, never silent."""
+    wins = list(windows)
+    # dedup is PER NODE: a tier ladder lives inside one node's store,
+    # and two nodes ingesting identical traffic legitimately seal
+    # byte-identical (same-digest) windows that must BOTH fold
+    covered: dict[tuple[str, str], str] = {}
+    for w in wins:
+        for row in w.compacted_from:
+            d = row.get("digest")
+            if d:
+                covered[(w.node, d)] = \
+                    f"{w.node}/{w.gadget} L{w.level} super-window"
+    kept: list[SealedWindow] = []
+    notes: list[str] = []
+    seen: set[tuple[str, str]] = set()
+    for w in wins:
+        who = f"{w.node}/{w.gadget} window {w.window} (L{w.level})"
+        if w.digest and (w.node, w.digest) in covered:
+            notes.append(f"{who}: superseded by "
+                         f"{covered[(w.node, w.digest)]} "
+                         "(compaction source not yet GC'd)")
+            continue
+        if w.digest and (w.node, w.digest) in seen:
+            notes.append(f"{who}: duplicate digest, folded once")
+            continue
+        if w.digest:
+            seen.add((w.node, w.digest))
+        kept.append(w)
+    return kept, notes
+
+
+def level_counts(windows: Iterable[SealedWindow]) -> dict[int, int]:
+    """Windows folded per compaction level — the consultation
+    accounting a query answer carries so resolution loss is visible."""
+    out: dict[int, int] = {}
+    for w in windows:
+        out[w.level] = out.get(w.level, 0) + 1
+    return out
 
 
 def answer_query(windows: Iterable[SealedWindow], *,
                  key: str | None = None, top: int = 20,
                  dropped: list[str] | None = None,
-                 errors: dict[str, str] | None = None) -> QueryAnswer:
+                 errors: dict[str, str] | None = None,
+                 levels: dict[int, int] | None = None,
+                 paths: dict[str, str] | None = None) -> QueryAnswer:
     """Fold sealed windows into one QueryAnswer. With `key`, the global
     numbers still cover the whole merged traffic and `slices` is
     restricted to that one subpopulation; without it, every observed
-    slice is answered."""
-    merged = merge_windows(windows)
+    slice is answered. Windows covered by a present super-window are
+    deduped (exactly-once across tiers) before the fold; `levels`
+    overrides the per-level accounting when the caller already folded
+    node-side (pushdown) and holds better counts than the one merged
+    window per node left here."""
+    kept, dedup_notes = dedupe_compacted(windows)
+    merged = merge_windows(kept)
     labels = merged.names
     hh = [(k, c, labels.get(k, f"0x{k:08x}"))
           for k, c in merged.heavy_hitters(top)]
@@ -115,7 +184,12 @@ def answer_query(windows: Iterable[SealedWindow], *,
             for k, c in ans["heavy_hitters"][:top]]
         slices[skey] = ans
     return QueryAnswer(
-        windows=merged.windows,
+        # `windows` reports how many sealed windows the answer
+        # CONSULTED: under pushdown the caller's per-node accounting
+        # (levels) holds that number — the one merged window per node
+        # that reached this fold would under-report it
+        windows=(sum(levels.values()) if levels is not None
+                 else merged.windows),
         nodes=merged.nodes,
         start_ts=merged.start_ts,
         end_ts=merged.end_ts,
@@ -125,8 +199,11 @@ def answer_query(windows: Iterable[SealedWindow], *,
         entropy_bits=merged.entropy_bits(),
         heavy_hitters=hh,
         slices=slices,
-        dropped_windows=list(merged.skipped) + list(dropped or []),
+        dropped_windows=(list(merged.skipped) + dedup_notes
+                         + list(dropped or [])),
         errors=dict(errors or {}),
+        levels=dict(levels) if levels is not None else level_counts(kept),
+        paths=dict(paths or {}),
     )
 
 
@@ -135,5 +212,6 @@ def decode_frames(frames: Iterable[tuple[dict, bytes]]
     return [decode_window(h, p) for h, p in frames]
 
 
-__all__ = ["QueryAnswer", "answer_query", "decode_frames", "pack_frames",
+__all__ = ["QueryAnswer", "answer_query", "decode_frames",
+           "dedupe_compacted", "level_counts", "pack_frames",
            "unpack_frames"]

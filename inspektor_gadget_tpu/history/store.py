@@ -43,6 +43,7 @@ from ..capture.journal import (
     JournalMetrics,
     JournalReader,
     JournalWriter,
+    _seg_name,
     build_manifest,
     is_journal,
 )
@@ -130,6 +131,48 @@ class _WindowJournal(JournalWriter):
         with self._win_mu:
             super().rotate()
 
+    def sync(self) -> None:
+        """fsync the active segment — the compaction engine's durability
+        barrier: a super-window frame must survive a crash BEFORE any of
+        its source segments is GC'd, or coverage is lost."""
+        with self._win_mu, self._mu:
+            try:
+                fd = os.open(self._active_path(), os.O_RDONLY)
+            except OSError:
+                return  # nothing appended yet: nothing to make durable
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+
+    def remove_segments(self, names: list[str], *,
+                        count_gc: bool = False
+                        ) -> tuple[int, int]:
+        """Delete sealed segments by name under the writer lock — the
+        one door compaction/archive GC and retention GC share, so the
+        two can never double-free a file or race the active segment
+        (which is refused here unconditionally). Returns
+        (removed, bytes_freed); missing files are skipped, not errors
+        (a concurrent retention pass may have won the race)."""
+        removed, freed = 0, 0
+        with self._win_mu, self._mu:
+            active = _seg_name(self._seg_n)
+            for name in names:
+                if not name or name == active \
+                        or name != os.path.basename(name):
+                    continue
+                path = os.path.join(self.path, name)
+                try:
+                    size = os.path.getsize(path)
+                    os.remove(path)
+                except OSError:
+                    continue
+                removed += 1
+                freed += size
+                if count_gc:
+                    self._m.gc.inc()
+        return removed, freed
+
     def close(self) -> dict:
         with self._win_mu:
             return super().close()
@@ -143,6 +186,10 @@ class HistoryStore:
         self._mu = threading.Lock()
         self._base: str | None = None
         self._writers: dict[tuple[str, str], _WindowJournal] = {}
+        # archive tiers are a property of a history AREA (base dir), not
+        # of the process: one tier per base, so a run pointing at its
+        # own --history-dir cannot rewire another area's rehydration
+        self._archives: dict[str, "object"] = {}
 
     # -- configuration ------------------------------------------------------
 
@@ -161,6 +208,35 @@ class HistoryStore:
         recording stays off until armed."""
         with self._mu:
             return self._base is not None
+
+    def set_archive(self, archive_dir: str | None,
+                    cache_bytes: int | None = None,
+                    base_dir: str | None = None) -> None:
+        """Configure (or clear) the archive tier for ONE history area
+        (base_dir; default the current base): a FilesystemArchive
+        rooted at archive_dir, with the rehydration cache under that
+        area (bounded LRU by cache_bytes). Agents opt in via
+        --history-archive-dir / operator history-archive-dir."""
+        base = os.path.abspath(history_base_dir(base_dir)
+                               if base_dir else self.base_dir())
+        if not archive_dir:
+            with self._mu:
+                self._archives.pop(base, None)
+            return
+        from .archive import ArchiveTier, FilesystemArchive
+        tier = ArchiveTier(
+            FilesystemArchive(archive_dir),
+            cache_dir=os.path.join(base, ".archive-cache"),
+            cache_bytes=cache_bytes or (64 << 20))
+        with self._mu:
+            self._archives[base] = tier
+
+    def archive(self, base_dir: str | None = None):
+        """The ArchiveTier configured for one history area (default
+        the current base), or None."""
+        base = os.path.abspath(base_dir or self.base_dir())
+        with self._mu:
+            return self._archives.get(base)
 
     # -- writing ------------------------------------------------------------
 
@@ -197,6 +273,19 @@ class HistoryStore:
                     metrics=HISTORY_METRICS)
                 self._writers[key] = w
         return w
+
+    def writer_for_dir(self, store_dir: str) -> _WindowJournal:
+        """The (cached) writer for an existing store directory — the
+        compaction engine resolves stores by path, not identity. The
+        (node, gadget) identity is recovered from the directory name,
+        so the engine and a live sealer of the same store share ONE
+        writer (and its lock)."""
+        base = os.path.dirname(os.path.abspath(store_dir))
+        name = os.path.basename(os.path.abspath(store_dir))
+        node, sep, gadget_key = name.partition("--")
+        if not sep:
+            node, gadget_key = "", name
+        return self.writer_for(gadget_key, node=node, base_dir=base)
 
     def append_window(self, win: SealedWindow, *,
                       writer: _WindowJournal) -> int:
@@ -325,6 +414,24 @@ class HistoryStore:
                 for loss in reader.losses:
                     losses.append({"store": os.path.basename(store),
                                    **loss.__dict__})
+            # archive tier: ranges overlapping offloaded segments
+            # rehydrate through the manifest (digest-verified; a
+            # corrupted object lands in `losses`, never in the fold)
+            arch = self.archive(os.path.dirname(store))
+            if arch is not None:
+                for header, payload in arch.frames_for_range(
+                        store, start_ts=start_ts, end_ts=end_ts,
+                        start_seq=start_seq, end_seq=end_seq, key=key,
+                        losses=losses):
+                    if gadget and header.get("gadget") != gadget:
+                        continue
+                    if node and header.get("node") != node:
+                        continue
+                    if not header_overlaps(
+                            header, start_ts=start_ts, end_ts=end_ts,
+                            start_seq=start_seq, end_seq=end_seq, key=key):
+                        continue
+                    yield header, (payload if with_payload else b"")
 
     @staticmethod
     def _seg_of(reader: JournalReader, header: dict) -> str | None:
@@ -337,24 +444,95 @@ class HistoryStore:
         return None
 
     def stats(self, base_dir: str | None = None) -> dict:
-        """Per-store window counts + disk usage (doctor / top windows)."""
+        """Per-store window counts + disk usage (doctor / top windows /
+        `ig-tpu history tiers`), broken down per compaction level and
+        per tier: each level reports windows, payload bytes, and its
+        oldest/newest window timestamps, so "how much resolution do I
+        still have for last Tuesday" reads straight off the store."""
         from ..capture.journal import dir_stats
         base = base_dir or self.base_dir()
+        arch = self.archive(base)
         stores = {}
         for store in self.store_dirs(base):
             reader = JournalReader(store, metrics=HISTORY_METRICS)
-            windows = sum(1 for _ in reader.records(
-                types=(wire.EV_WINDOW,)))
+            windows = 0
+            levels: dict[int, dict] = {}
+            for header, payload in reader.records(
+                    types=(wire.EV_WINDOW,)):
+                windows += 1
+                lvl = int(header.get("level", 0))
+                row = levels.setdefault(
+                    lvl, {"windows": 0, "bytes": 0,
+                          "oldest_ts": None, "newest_ts": None,
+                          "source_windows": 0})
+                row["windows"] += 1
+                row["bytes"] += len(payload)
+                start = float(header.get("start_ts", 0.0))
+                end = float(header.get("end_ts", 0.0))
+                row["oldest_ts"] = (start if row["oldest_ts"] is None
+                                    else min(row["oldest_ts"], start))
+                row["newest_ts"] = (end if row["newest_ts"] is None
+                                    else max(row["newest_ts"], end))
+                row["source_windows"] += (
+                    len(header.get("compacted_from") or []) or 1)
             stores[os.path.basename(store)] = {
                 "path": store,
                 "windows": windows,
+                "levels": {str(k): v for k, v in sorted(levels.items())},
                 "segments": len(reader._segment_files()),
                 "losses": [loss.__dict__ for loss in reader.losses],
+                "archive": (arch.stats(store) if arch is not None
+                            else None),
             }
         segments, total_bytes = dir_stats(base) if os.path.isdir(base) \
             else (0, 0)
         return {"base": base, "stores": stores,
                 "segments": segments, "bytes": total_bytes}
+
+    def tier_stats(self, base_dir: str | None = None, *,
+                   ttl: float = 0.0) -> dict:
+        """The fleet-facing tier summary (DumpState / doctor
+        history_tiers): windows+bytes per level across every store,
+        plus the archive tier's footprint and cache health. The walk
+        decodes every store frame, so hot polled surfaces (DumpState —
+        fleet health/runs/alerts all ride it) pass a ttl and reuse the
+        last answer instead of re-scanning a possibly-large store on
+        every poll."""
+        import time as _time
+        base = os.path.abspath(base_dir or self.base_dir())
+        if ttl > 0:
+            with self._mu:
+                cached = getattr(self, "_tier_cache", None)
+            if cached is not None and cached[0] == base \
+                    and _time.monotonic() - cached[1] < ttl:
+                return cached[2]
+        full = self.stats(base_dir)
+        by_level: dict[str, dict] = {}
+        archived = {"segments": 0, "bytes": 0, "windows": 0}
+        cache = None
+        for srow in full["stores"].values():
+            for lvl, row in (srow.get("levels") or {}).items():
+                agg = by_level.setdefault(
+                    lvl, {"windows": 0, "bytes": 0,
+                          "oldest_ts": None, "newest_ts": None})
+                agg["windows"] += row["windows"]
+                agg["bytes"] += row["bytes"]
+                for k, fn in (("oldest_ts", min), ("newest_ts", max)):
+                    if row[k] is not None:
+                        agg[k] = (row[k] if agg[k] is None
+                                  else fn(agg[k], row[k]))
+            a = srow.get("archive")
+            if a:
+                archived["segments"] += a["segments"]
+                archived["bytes"] += a["bytes"]
+                archived["windows"] += a["windows"]
+                cache = a["cache"]
+        out = {"base": full["base"], "stores": len(full["stores"]),
+               "bytes": full["bytes"], "levels": by_level,
+               "archived": archived, "archive_cache": cache}
+        with self._mu:
+            self._tier_cache = (base, _time.monotonic(), out)
+        return out
 
 
 # the process-wide singleton the tpusketch operator seals into

@@ -148,6 +148,16 @@ class SealedWindow:
     slices_dropped: int = 0        # subpopulations over the per-window cap
     seq: int = 0                   # store seq once appended
     digest: str = ""
+    # -- tier plane (history/lifecycle.py) --------------------------------
+    # level 0 = sealed at native resolution by the operator; level N>0 =
+    # a super-window the compaction engine merged from aged level-(N-1)
+    # windows per the resolution schedule. compacted_from is the sealed
+    # provenance list: one row per source window ({digest, seq, window,
+    # run_id, start_ts, end_ts, level}) so coverage is auditable and a
+    # crash between super-window append and source GC is deduplicatable
+    # at query time (the source's digest is in exactly one list).
+    level: int = 0
+    compacted_from: list[dict] = dataclasses.field(default_factory=list)
 
     @property
     def slice_keys(self) -> list[str]:
@@ -170,6 +180,11 @@ def window_digest(win: SealedWindow) -> str:
         "events": int(win.events),
         "drops": int(win.drops),
         "slices_dropped": int(win.slices_dropped),
+        # resolution identity: the same merged state at a different tier
+        # is a different window (compacted_from stays OUT — provenance
+        # lists are trimmed/audited without changing state identity).
+        # Level 0 omits the field so pre-tier digests stay reproducible.
+        **({"level": int(win.level)} if win.level else {}),
         "cms": arr(win.cms),
         "hll": arr(win.hll),
         "ent": arr(win.ent),
@@ -234,6 +249,10 @@ def encode_window(win: SealedWindow) -> tuple[dict, bytes]:
         "names": {str(k): v for k, v in (win.names or {}).items()},
         "digest": win.digest or window_digest(win),
     }
+    if win.level:
+        header["level"] = int(win.level)
+    if win.compacted_from:
+        header["compacted_from"] = list(win.compacted_from)
     return header, buf.getvalue()
 
 
@@ -271,6 +290,8 @@ def decode_window(header: dict, payload: bytes) -> SealedWindow:
         slices_dropped=int(header.get("slices_dropped", 0)),
         seq=int(header.get("seq", 0)),
         digest=header.get("digest", ""),
+        level=int(header.get("level", 0)),
+        compacted_from=list(header.get("compacted_from") or []),
     )
 
 
@@ -411,8 +432,58 @@ def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
     return out
 
 
+def provenance_row(win: SealedWindow) -> dict:
+    """One compacted_from entry: enough to audit that the source's
+    seq/ts coverage landed in exactly one super-window, and to dedup a
+    source that survived a crash between super-window append and GC."""
+    return {"digest": win.digest, "seq": int(win.seq),
+            "window": int(win.window), "run_id": win.run_id,
+            "start_ts": float(win.start_ts), "end_ts": float(win.end_ts),
+            "level": int(win.level)}
+
+
+def merged_to_sealed(merged: MergedWindows, *, gadget: str, node: str,
+                     level: int = 0, window: int = 0, run_id: str = "",
+                     compacted_from: list[dict] | None = None,
+                     ) -> SealedWindow:
+    """MergedWindows → one SealedWindow — the shape both the compaction
+    engine (a super-window per time bucket) and the QueryWindows
+    pushdown reply (one merged window per node) seal a fold into. The
+    candidate union is kept WHOLE (bounded by windows × top-k), so the
+    additive planes and top-k estimates survive re-merging downstream
+    with no extra truncation error at this boundary."""
+    cand = sorted(merged.candidates.items(), key=lambda kv: -kv[1])
+    slices: dict[str, dict] = {}
+    for skey, s in merged.slices.items():
+        slices[skey] = {
+            "events": int(s["events"]),
+            "hll": s["hll"],
+            "ent": s["ent"],
+            "hh": sorted(s["hh"].items(), key=lambda kv: -kv[1]),
+        }
+    win = SealedWindow(
+        gadget=gadget, node=node, run_id=run_id, window=int(window),
+        start_ts=float(merged.start_ts), end_ts=float(merged.end_ts),
+        events=int(merged.events), drops=int(merged.drops),
+        cms=(merged.cms if merged.cms is not None
+             else np.zeros((1, 1), np.int64)),
+        hll=(merged.hll if merged.hll is not None
+             else np.zeros(1, np.int32)),
+        ent=(merged.ent if merged.ent is not None
+             else np.zeros(1, np.float64)),
+        topk_keys=np.array([k for k, _ in cand], dtype=np.uint32),
+        topk_counts=np.array([c for _, c in cand], dtype=np.int64),
+        slices=slices,
+        names=dict(merged.names),
+        level=int(level),
+        compacted_from=list(compacted_from or []),
+    )
+    win.digest = window_digest(win)
+    return win
+
+
 __all__ = ["MergedWindows", "SLICE_ENT_LOG2_WIDTH", "SLICE_HH_K",
            "SLICE_HLL_P", "SealedWindow", "SliceSketch", "WINDOW_SCHEMA",
            "decode_window", "encode_window", "entropy_bits",
-           "header_overlaps", "merge_windows", "slice_hll_estimate",
-           "window_digest"]
+           "header_overlaps", "merge_windows", "merged_to_sealed",
+           "provenance_row", "slice_hll_estimate", "window_digest"]

@@ -37,12 +37,22 @@ from ..ops.hll import hll_init, hll_update
 from ..ops.sketches import bundle_digest_jit, bundle_ingest_jit, decode_digest
 from ..ops.window import wcms_advance, wcms_init, wcms_query, wcms_update
 from ..params import ParamDesc, ParamDescs, Params, TypeHint
+from ..params.validators import validate_int_range
 from ..sources.batch import EventBatch, FoldedBatch
 from ..sources.staging import H2DStager, PinnedBufferPool
 from ..telemetry import counter, histogram
 from ..telemetry.tracing import TRACER, device_annotation
 from ..utils.logger import get_logger
 from .operators import Operator, OperatorInstance, register
+
+# the history package imports agent wire machinery — keep it lazy here
+# (the param default/validator are the only module-load-time needs)
+_DEFAULT_SCHEDULE = "1m@24h,10m@7d,1h@inf"
+
+
+def _validate_history_schedule(value: str) -> None:
+    from ..history import validate_schedule
+    validate_schedule(value)
 
 # device-plane telemetry (batch-grain; the histograms time dispatch-side —
 # device completion is async and surfaces in the next blocking read)
@@ -242,6 +252,33 @@ class TpuSketch(Operator):
                       type_hint=TypeHint.INT,
                       description="subpopulation slices tracked per window "
                                   "(overflow dropped and accounted)"),
+            # tiered history lifecycle (history/lifecycle.py +
+            # history/archive.py): retention as a POLICY — aged windows
+            # compact into coarser super-windows per the resolution
+            # schedule, fully-compacted cold segments offload to the
+            # archive tier. All four validated LOUDLY before the run.
+            ParamDesc(key="history-compact", default="false",
+                      type_hint=TypeHint.BOOL,
+                      description="run time-decayed compaction over this "
+                                  "run's history store (aged windows merge "
+                                  "into coarser super-windows per "
+                                  "history-schedule)"),
+            ParamDesc(key="history-schedule", default=_DEFAULT_SCHEDULE,
+                      validator=_validate_history_schedule,
+                      description="resolution schedule "
+                                  "res@horizon[,res@horizon...] (e.g. "
+                                  "1m@24h,10m@7d,1h@inf); the last horizon "
+                                  "must be inf"),
+            ParamDesc(key="history-archive-dir", default="",
+                      description="offload fully-compacted cold segments "
+                                  "to this archive root (object-store-"
+                                  "shaped backend; filesystem impl today) "
+                                  "with manifest-driven rehydration"),
+            ParamDesc(key="history-archive-cache-bytes",
+                      default=str(64 << 20), type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=1 << 16),
+                      description="rehydration cache budget (LRU by "
+                                  "bytes, hit/miss counted)"),
         ])
 
     def instantiate(self, ctx: GadgetContext, gadget: Any,
@@ -383,6 +420,29 @@ class TpuSketchInstance(OperatorInstance):
                 _ckpt_log.warning("history store open failed (sealing "
                                   "disabled for this run): %r", e)
                 self._hist_on = False
+        # tiered lifecycle: compaction engine + archive tier opt-ins
+        self._hist_engine = None
+        if self._hist_on:
+            arch_dir = (p.get("history-archive-dir").as_string()
+                        if "history-archive-dir" in p else "")
+            if arch_dir:
+                from ..history import HISTORY
+                cache_b = (p.get("history-archive-cache-bytes").as_int()
+                           if "history-archive-cache-bytes" in p
+                           else 64 << 20)
+                HISTORY.set_archive(arch_dir, cache_b)
+            compact = (p.get("history-compact").as_bool()
+                       if "history-compact" in p else False)
+            if compact:
+                from ..history import CompactionEngine
+                schedule = (p.get("history-schedule").as_string()
+                            if "history-schedule" in p
+                            else _DEFAULT_SCHEDULE)
+                # ages measure against the same (injectable) clock the
+                # sealer stamps windows with — a replay/sim clock must
+                # not see its windows as months old
+                self._hist_engine = CompactionEngine(
+                    schedule, clock=self._hist_clock)
         # checkpoint/resume: keyed by gadget identity so a restarted run
         # (new run_id) finds its predecessor's state
         self._ckpt_key = ctx.desc.full_name.replace("/", "-")
@@ -795,6 +855,15 @@ class TpuSketchInstance(OperatorInstance):
                           "digest": win.digest})
                 except Exception as he:  # noqa: BLE001 — announce only
                     _ckpt_log.warning("window announce failed: %r", he)
+        if self._hist_engine is not None:
+            # time-gated background pass: sealed segments whose windows
+            # aged past their level's horizon fold into super-windows
+            # (the active segment — where this window just landed — is
+            # never touched)
+            try:
+                self._hist_engine.maybe_compact(self._hist_writer.path)
+            except (OSError, ValueError) as e:
+                _ckpt_log.warning("compaction pass failed: %r", e)
         # open the next window: rotate the ring, fresh HLL, new deltas
         self._wcms = _wcms_advance_jit(self._wcms)
         self._win_hll = hll_init(self._win_hll.p)
@@ -884,6 +953,16 @@ class TpuSketchInstance(OperatorInstance):
                 self.seal_window()
                 from ..history import HISTORY
                 HISTORY.release(self._hist_writer)
+                if self._hist_engine is not None:
+                    # the release just rotated this run's windows into a
+                    # sealed segment: one final pass lets a short-horizon
+                    # schedule compact them before the next run
+                    try:
+                        self._hist_engine.compact_store(
+                            self._hist_writer.path)
+                    except (OSError, ValueError) as e:
+                        _ckpt_log.warning(
+                            "teardown compaction failed: %r", e)
             if self._stager is not None:
                 # release every in-flight staging block (and zero the
                 # occupancy gauge) before the instance goes away

@@ -294,24 +294,78 @@ class GrpcRuntime(Runtime):
         return self._fanout_unary(pull)
 
     def query_history(self, *, key: str | None = None, top: int = 20,
-                      **kw) -> "Any":
-        """The fleet-wide range query: pull only index-overlapping
-        windows from every node and merge them client-side (the
-        disaggregation fold — bundle_merge's algebra applied to sealed
-        state). Per-node errors are recorded in the answer, never
-        fatal: a crashed node's peers still answer for their share."""
-        from ..history import answer_query, decode_frames
-        results, errors = self.fetch_windows(key=key, **kw)
+                      pushdown: bool = True, **kw) -> "Any":
+        """The fleet-wide range query. Preferred path: QueryWindows
+        PUSHDOWN — every agent folds the query node-side and ships ONE
+        merged window, so wire cost is O(nodes) instead of O(windows).
+        Agents that predate the RPC (UNIMPLEMENTED) fall back PER NODE
+        to the PR-6 list+fetch pull, and the answer records which path
+        each node took (`answer.paths`). Per-node errors are recorded
+        in the answer, never fatal: a crashed node's peers still answer
+        for their share."""
+        import grpc as _grpc
+
+        from ..history import (answer_query, decode_frames,
+                               dedupe_compacted, level_counts)
         windows = []
         dropped: list[str] = []
-        for node, res in results.items():
-            windows.extend(decode_frames(res["frames"]))
-            for loss in res["losses"]:
+        errors: dict[str, str] = {}
+        paths: dict[str, str] = {}
+        levels_total: dict[int, int] = {}
+
+        def add_levels(levels: dict[int, int]) -> None:
+            for lvl, n in levels.items():
+                levels_total[lvl] = levels_total.get(lvl, 0) + n
+
+        def add_losses(node: str, losses) -> None:
+            for loss in losses or ():
                 dropped.append(f"{node}: torn window tail "
                                f"({loss.get('reason', '?')}, "
                                f"{loss.get('dropped_bytes', 0)} bytes)")
+
+        for node in self.targets:
+            client = self._client(node)
+            res = None
+            if pushdown:
+                try:
+                    res = client.query_windows(key=key, **kw)
+                except _grpc.RpcError as e:
+                    if e.code() != _grpc.StatusCode.UNIMPLEMENTED:
+                        errors[node] = f"{e.code().name}: {e.details()}"
+                        paths[node] = "pushdown"
+                        continue
+                    # pre-pushdown agent: fall through to list+fetch
+                except Exception as e:  # noqa: BLE001 — per-node isolation
+                    errors[node] = str(e)
+                    paths[node] = "pushdown"
+                    continue
+            if res is not None:
+                paths[node] = "pushdown"
+                if res["window"] is not None:
+                    windows.append(res["window"])
+                add_levels(res["levels"])
+                for note in res["dropped"]:
+                    dropped.append(f"{node}: {note}")
+                add_losses(node, res["losses"])
+                continue
+            paths[node] = "fetch"
+            try:
+                listing = client.list_windows(key=key, **kw)
+                if listing.get("windows"):
+                    frames, losses = client.fetch_windows(key=key, **kw)
+                else:
+                    frames, losses = [], listing.get("losses") or []
+                kept, notes = dedupe_compacted(decode_frames(frames))
+                windows.extend(kept)
+                add_levels(level_counts(kept))
+                for note in notes:
+                    dropped.append(f"{node}: {note}")
+                add_losses(node, losses)
+            except Exception as e:  # noqa: BLE001 — per-node isolation
+                errors[node] = str(e)
         return answer_query(windows, key=key, top=top, dropped=dropped,
-                            errors=errors)
+                            errors=errors, levels=levels_total,
+                            paths=paths)
 
     # -- shared-run plane (subscribe-aware fan-out) --------------------------
 

@@ -32,7 +32,7 @@ def test_probe_windows_names_and_shape():
                 "mountinfo", "procfs", "blktrace", "tcpinfo", "audit",
                 "captrace", "fstrace", "sockstate", "sigtrace",
                 "container_runtime", "capture_dir", "history_dir",
-                "fleet_health", "shared_runs"}
+                "history_tiers", "fleet_health", "shared_runs"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail

@@ -1,0 +1,748 @@
+"""Tiered history lifecycle (ISSUE 13): resolution schedules,
+time-decayed compaction, archive offload/rehydration, query pushdown,
+and the pagination/accounting satellites.
+
+The fast tier of the subsystem: everything here runs on synthetic
+sealed windows (no gadget runs, no jax device work) so the crash,
+interleaving, and exactness disciplines are pinned cheaply;
+tests/test_history_tiers_e2e.py drives the same machinery through real
+agents and the tpusketch sealer.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from inspektor_gadget_tpu.history import (
+    ARCHIVE_MANIFEST,
+    ArchiveTier,
+    CompactionEngine,
+    FilesystemArchive,
+    HistoryStore,
+    SealedWindow,
+    answer_query,
+    decode_frames,
+    dedupe_compacted,
+    level_counts,
+    merge_windows,
+    parse_schedule,
+    window_digest,
+)
+from inspektor_gadget_tpu.history.lifecycle import (
+    DEFAULT_SCHEDULE,
+    _tm_compactions,
+    _tm_reclaimed,
+)
+from inspektor_gadget_tpu.history.store import HISTORY_METRICS
+
+T0 = 1_000_000.0
+FUTURE = T0 + 10_000_000.0
+
+
+def _window(i: int, *, node="lcnode", gadget="trace/lc", span=10.0,
+            events=100, depth=4, width=64, seed=None) -> SealedWindow:
+    rng = np.random.default_rng(i if seed is None else seed)
+    win = SealedWindow(
+        gadget=gadget, node=node, run_id="r1", window=i + 1,
+        start_ts=T0 + i * span, end_ts=T0 + (i + 1) * span,
+        events=events, drops=i % 3,
+        cms=rng.integers(0, 50, (depth, width)).astype(np.int32),
+        hll=rng.integers(0, 6, 256).astype(np.int32),
+        # integer-valued float32 buckets: sums stay exact under any
+        # association, so compaction equality asserts can be exact
+        ent=rng.integers(0, 20, 64).astype(np.float32),
+        topk_keys=rng.integers(1, 1 << 31, 8).astype(np.uint32),
+        topk_counts=rng.integers(1, 100, 8).astype(np.int64),
+        slices={f"mntns:{100 + i % 2}": {
+            "events": 10, "hll": rng.integers(0, 3, 256).astype(np.uint8),
+            "ent": rng.integers(0, 5, 64).astype(np.int64),
+            "hh": [(int(i) + 1, 3)]}},
+    )
+    win.digest = window_digest(win)
+    return win
+
+
+def _seed_store(tmp_path, n=12, *, node="lcnode", rotate_every=None,
+                **writer_kw):
+    store = HistoryStore()
+    base = str(tmp_path / "hist")
+    store.set_base_dir(base)
+    writer = store.writer_for("trace-lc", node=node, base_dir=base,
+                              **writer_kw)
+    for i in range(n):
+        store.append_window(_window(i, node=node), writer=writer)
+        if rotate_every and (i + 1) % rotate_every == 0:
+            writer.rotate()
+    writer.rotate()
+    return store, base, os.path.join(base, f"{node}--trace-lc")
+
+
+def _ground_truth(store, base):
+    frames = list(store.fetch_windows(base_dir=base, gadget="trace/lc"))
+    return merge_windows(decode_frames(frames))
+
+
+def _fold(store, base):
+    frames = list(store.fetch_windows(base_dir=base, gadget="trace/lc"))
+    kept, notes = dedupe_compacted(decode_frames(frames))
+    return merge_windows(kept), kept, notes
+
+
+def _assert_fold_equals(merged, truth):
+    assert merged.events == truth.events
+    assert merged.drops == truth.drops
+    assert np.array_equal(merged.cms, truth.cms)
+    assert np.array_equal(merged.hll, truth.hll)
+    assert np.array_equal(merged.ent, truth.ent)
+    assert merged.candidates == truth.candidates
+    for skey, s in truth.slices.items():
+        assert merged.slices[skey]["events"] == s["events"]
+        assert np.array_equal(merged.slices[skey]["hll"], s["hll"])
+
+
+# ---------------------------------------------------------------------------
+# Resolution schedule grammar
+# ---------------------------------------------------------------------------
+
+def test_schedule_grammar_accepts_documented_forms():
+    levels = parse_schedule("1m@24h,10m@7d,1h@inf")
+    assert [lvl.resolution for lvl in levels] == [60.0, 600.0, 3600.0]
+    assert levels[1].horizon == 7 * 86400.0
+    assert math.isinf(levels[-1].horizon)
+    # the unicode infinity and day+duration composites parse too
+    levels = parse_schedule("30s@5m, 5m@1d12h, 1h@∞")
+    assert levels[1].horizon == 86400.0 + 12 * 3600.0
+    # the default the params layer ships must itself be valid AND match
+    # the operator's copy (kept literal there to avoid an import cycle)
+    from inspektor_gadget_tpu.operators.tpusketch import _DEFAULT_SCHEDULE
+    assert _DEFAULT_SCHEDULE == DEFAULT_SCHEDULE
+    parse_schedule(DEFAULT_SCHEDULE)
+
+
+@pytest.mark.parametrize("spec,frag", [
+    ("", "empty"),
+    ("1m", "not <resolution>@<horizon>"),
+    ("1m@", "not <resolution>@<horizon>"),
+    ("@1h", "not <resolution>@<horizon>"),
+    ("banana@1h", "invalid duration"),
+    ("0s@1h,1m@inf", "resolution must be a finite positive"),
+    ("inf@1h,1m@inf", "resolution must be a finite positive"),
+    ("10m@1h,1m@2h,1h@inf", "strictly coarsen"),
+    ("1m@2h,10m@1h,1h@inf", "strictly grow"),
+    ("1m@24h,10m@7d", "last horizon must be inf"),
+    ("1m@inf,10m@inf", "strictly grow"),
+])
+def test_schedule_grammar_is_loud(spec, frag):
+    with pytest.raises(ValueError):
+        parse_schedule(spec)
+    try:
+        parse_schedule(spec)
+    except ValueError as e:
+        assert frag in str(e), (spec, str(e))
+
+
+def test_history_params_validated_loudly():
+    """The params layer refuses a bad schedule / cache budget BEFORE a
+    run starts (the stop-result-timeout pattern)."""
+    from inspektor_gadget_tpu.operators import operators as op_registry
+    from inspektor_gadget_tpu.params import ParamError
+    sp = op_registry.get("tpusketch").instance_params().to_params()
+    with pytest.raises(ParamError, match="history-schedule"):
+        sp.set("history-schedule", "10m@1h,1m@2h")
+    with pytest.raises(ParamError, match="history-archive-cache-bytes"):
+        sp.set("history-archive-cache-bytes", "12")
+    sp.set("history-schedule", "30s@10m,10m@inf")  # good one sticks
+    sp.set("history-compact", "true")
+    with pytest.raises(ParamError):
+        sp.set("history-compact", "maybe")
+
+
+# ---------------------------------------------------------------------------
+# Compaction: exactness, provenance, crash discipline
+# ---------------------------------------------------------------------------
+
+def test_compaction_folds_exactly_and_audits_provenance(tmp_path):
+    store, base, store_dir = _seed_store(tmp_path, n=12)
+    truth = _ground_truth(store, base)
+    before = sum(os.path.getsize(os.path.join(store_dir, f))
+                 for f in os.listdir(store_dir) if f.startswith("seg-"))
+    c0 = _tm_compactions.value
+    r0 = _tm_reclaimed.value
+    g0 = HISTORY_METRICS.gc.value
+
+    engine = CompactionEngine("10s@1m,60s@1d,600s@inf", store=store,
+                              clock=lambda: FUTURE)
+    stats = engine.compact_store(store_dir)
+    assert stats["source_windows"] == 12
+    # 120s of data in 60s buckets (T0 is not bucket-aligned: 3 buckets)
+    assert stats["super_windows"] == 3
+    assert stats["segments_deleted"] >= 1
+    assert stats["levels"] == {1: 3}
+    # byte footprint shrinks; reclaim accounted; retention GC untouched
+    after = sum(os.path.getsize(os.path.join(store_dir, f))
+                for f in os.listdir(store_dir) if f.startswith("seg-"))
+    assert after < before
+    assert _tm_compactions.value == c0 + 1
+    assert _tm_reclaimed.value - r0 == stats["bytes_reclaimed"] > 0
+    assert HISTORY_METRICS.gc.value == g0
+
+    merged, kept, notes = _fold(store, base)
+    assert notes == []
+    assert {w.level for w in kept} == {1}
+    assert level_counts(kept) == {1: 3}
+    _assert_fold_equals(merged, truth)
+    # provenance audit: every source window's digest (and its seq/ts
+    # coverage) lands in EXACTLY one super-window
+    seen: dict[str, int] = {}
+    spans = []
+    for w in kept:
+        for row in w.compacted_from:
+            seen[row["digest"]] = seen.get(row["digest"], 0) + 1
+            spans.append((row["start_ts"], row["end_ts"]))
+            assert row["seq"] > 0 and row["level"] == 0
+    assert sorted(seen.values()) == [1] * 12
+    assert min(s for s, _ in spans) == T0
+    assert max(e for _, e in spans) == T0 + 120.0
+
+
+def test_compaction_ladder_reaches_final_level(tmp_path):
+    store, base, store_dir = _seed_store(tmp_path, n=12)
+    truth = _ground_truth(store, base)
+    engine = CompactionEngine("10s@1m,60s@1d,600s@inf", store=store,
+                              clock=lambda: FUTURE)
+    engine.compact_store(store_dir)   # L0 -> L1
+    engine.compact_store(store_dir)   # L1 (aged past 1d) -> L2
+    merged, kept, _ = _fold(store, base)
+    assert {w.level for w in kept} == {2}
+    assert len(kept) == 1             # 120s fits one 600s bucket
+    _assert_fold_equals(merged, truth)
+    # the final level never self-compacts: a third pass is a no-op
+    stats = engine.compact_store(store_dir)
+    assert stats["super_windows"] == 0 and stats["segments_deleted"] == 0
+
+
+def test_active_segment_and_young_windows_are_never_compacted(tmp_path):
+    store, base, store_dir = _seed_store(tmp_path, n=6, rotate_every=3)
+    # 3 sealed old windows + 3 sealed young + unsealed appends on top
+    writer = store.writer_for_dir(store_dir)
+    store.append_window(_window(99, seed=99), writer=writer)  # active seg
+    young_cut = T0 + 3 * 10.0
+    engine = CompactionEngine(
+        "10s@1m,60s@inf", store=store,
+        clock=lambda: young_cut + 61.0)  # only windows 1..3 aged > 1m
+    stats = engine.compact_store(store_dir)
+    assert stats["source_windows"] == 3
+    merged, kept, _ = _fold(store, base)
+    levels = level_counts(kept)
+    # 3 aged sources -> 2 super-windows (bucket split); 3 young + 1
+    # active-segment window stay at native resolution
+    assert levels[1] == 2 and levels[0] == 4
+    # and nothing was lost
+    truth_events = 6 * 100 + 100
+    assert merged.events == truth_events
+
+
+def test_sigkill_between_super_window_and_gc_is_exactly_once(tmp_path):
+    """Crash injection at the widest dangerous window: super-windows
+    durable, sources not yet GC'd. Queries dedup (exactly-once), the
+    next pass finishes the GC without re-merging, and nothing is lost
+    or double-counted — digest-audited."""
+    store, base, store_dir = _seed_store(tmp_path, n=12)
+    truth = _ground_truth(store, base)
+    # two levels so L1 is final: the rerun must ONLY finish the GC, not
+    # also ladder the now-aged supers a level up
+    engine = CompactionEngine("10s@1m,60s@inf", store=store,
+                              clock=lambda: FUTURE)
+
+    def boom():
+        raise RuntimeError("simulated SIGKILL before source GC")
+
+    engine._before_gc = boom
+    with pytest.raises(RuntimeError, match="simulated SIGKILL"):
+        engine.compact_store(store_dir)
+
+    # both tiers are on disk now; the fold must count each source once
+    frames = list(store.fetch_windows(base_dir=base, gadget="trace/lc"))
+    assert len(frames) == 12 + 3
+    merged, kept, notes = _fold(store, base)
+    assert len(kept) == 3 and {w.level for w in kept} == {1}
+    assert len(notes) == 12 and all("superseded" in n for n in notes)
+    _assert_fold_equals(merged, truth)
+
+    # reopen + rerun converges: sources GC'd, nothing re-merged
+    engine._before_gc = None
+    stats = engine.compact_store(store_dir)
+    assert stats["super_windows"] == 0
+    assert stats["segments_deleted"] >= 1
+    merged, kept, notes = _fold(store, base)
+    assert notes == [] and len(kept) == 3
+    _assert_fold_equals(merged, truth)
+
+
+def test_retention_gc_and_compaction_interleave_exactly(tmp_path):
+    """Satellite: concurrent retention GC (inside the writer's append
+    path) and compaction passes on ONE store never delete the active
+    segment, never double-free, and leave the gc/compaction accounting
+    exact: every removed segment is counted by exactly one of the two
+    planes."""
+    store = HistoryStore()
+    base = str(tmp_path / "hist")
+    store.set_base_dir(base)
+    writer = store.writer_for("trace-lc", node="lcnode", base_dir=base,
+                              retention_bytes=1 << 30,
+                              retention_segments=4,
+                              max_segment_age=0.0)
+    store_dir = os.path.join(base, "lcnode--trace-lc")
+    engine = CompactionEngine("10s@1m,60s@inf", store=store,
+                              clock=lambda: FUTURE)
+    g0 = HISTORY_METRICS.gc.value
+    errors: list = []
+    stats_rows: list[dict] = []
+
+    def sealer():
+        try:
+            for i in range(48):
+                store.append_window(_window(i, seed=1000 + i),
+                                    writer=writer)
+                if (i + 1) % 4 == 0:
+                    writer.rotate()
+        except Exception as e:  # noqa: BLE001 — assert below
+            errors.append(e)
+
+    def compactor():
+        try:
+            for _ in range(16):
+                stats_rows.append(engine.compact_store(store_dir))
+        except Exception as e:  # noqa: BLE001 — assert below
+            errors.append(e)
+
+    threads = [threading.Thread(target=sealer),
+               threading.Thread(target=compactor)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    stats_rows.append(engine.compact_store(store_dir))  # settle
+
+    # the active segment survived and the store still appends
+    store.append_window(_window(999, seed=999), writer=writer)
+    # exact accounting: every sealed segment ever created either still
+    # exists, was deleted by retention GC (counted in ig_history_gc),
+    # or was deleted by compaction (counted in its stats) — sums match,
+    # so nothing was double-freed or freed uncounted
+    from inspektor_gadget_tpu.capture.journal import JournalReader
+    reader = JournalReader(store_dir, metrics=HISTORY_METRICS)
+    sealed_rows = {row["file"] for row in reader.index}
+    present = {os.path.basename(p) for p in reader._segment_files()}
+    deleted = len(sealed_rows - present)
+    gc_delta = HISTORY_METRICS.gc.value - g0
+    compact_deleted = sum(r["segments_deleted"] for r in stats_rows)
+    assert deleted == gc_delta + compact_deleted, (
+        deleted, gc_delta, compact_deleted)
+    # no window was lost except by retention policy: everything still
+    # present folds cleanly with exactly-once provenance
+    merged, kept, notes = _fold(store, base)
+    assert all("superseded" in n for n in notes)
+    seen: dict[str, int] = {}
+    for w in kept:
+        for row in w.compacted_from:
+            seen[row["digest"]] = seen.get(row["digest"], 0) + 1
+    assert all(v == 1 for v in seen.values())
+
+
+def test_slice_geometry_mismatch_skips_bucket_keeps_sources(tmp_path):
+    """A bucket whose windows disagree on SLICE geometry (sealed by a
+    build with different slice constants) is left at its current level
+    — a partial merge would silently drop that slice's coverage when
+    the sources are GC'd. Other buckets still compact; the skipped
+    bucket's segment survives whole."""
+    store = HistoryStore()
+    base = str(tmp_path / "hist")
+    store.set_base_dir(base)
+    writer = store.writer_for("trace-lc", node="lcnode", base_dir=base)
+    wins = [_window(0), _window(1)]
+    # second window disagrees on the SHARED slice key's ent geometry
+    wins[1].slices = {"mntns:100": {
+        "events": 5, "hll": np.zeros(256, np.uint8),
+        "ent": np.zeros(16, np.int64), "hh": [(7, 1)]}}
+    wins[1].digest = window_digest(wins[1])
+    for w in wins:
+        store.append_window(w, writer=writer)
+    writer.rotate()
+    store_dir = os.path.join(base, "lcnode--trace-lc")
+    truth = _ground_truth(store, base)
+    engine = CompactionEngine("10s@1m,600s@inf", store=store,
+                              clock=lambda: FUTURE)
+    stats = engine.compact_store(store_dir)
+    assert stats["super_windows"] == 0
+    assert stats.get("skipped_buckets") == 1
+    assert stats["segments_deleted"] == 0   # coverage kept whole
+    merged, kept, _ = _fold(store, base)
+    assert len(kept) == 2 and {w.level for w in kept} == {0}
+    assert merged.events == truth.events
+
+
+# ---------------------------------------------------------------------------
+# Archive tier: offload, rehydration, digest verification
+# ---------------------------------------------------------------------------
+
+def _archived_store(tmp_path, cache_bytes=1 << 20):
+    store, base, store_dir = _seed_store(tmp_path, n=12)
+    truth = _ground_truth(store, base)
+    engine = CompactionEngine("10s@1m,60s@inf", store=store,
+                              clock=lambda: FUTURE)
+    engine.compact_store(store_dir)   # everything at final level 1
+    store.set_archive(str(tmp_path / "objects"), cache_bytes,
+                      base_dir=base)
+    tier = store.archive(base)
+    # the super-windows live in their own sealed segment (compaction
+    # rotates); offload every fully-final sealed segment
+    stats = tier.archive_store(store_dir, min_level=1,
+                               writer=store.writer_for_dir(store_dir))
+    return store, base, store_dir, tier, truth, stats
+
+
+def test_archive_offload_and_manifest_rehydration(tmp_path):
+    store, base, store_dir, tier, truth, stats = _archived_store(tmp_path)
+    assert stats["segments"] == 1 and stats["windows"] == 3
+    assert os.path.isfile(os.path.join(store_dir, ARCHIVE_MANIFEST))
+    rows = tier.manifest_rows(store_dir)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["level"] == 1 and row["windows"] == 3
+    assert row["keys"] and row["digest"]
+    # the local segment is gone; the object exists under <store>/<seg>
+    assert not os.path.isfile(os.path.join(store_dir, row["file"]))
+    assert tier.backend.get(row["object"])
+
+    # a range query rehydrates through the manifest and answers
+    # identically to the pre-archive fold
+    merged, kept, notes = _fold(store, base)
+    assert notes == []
+    _assert_fold_equals(merged, truth)
+    assert tier.misses == 1 and tier.hits == 0
+    # second query: cache hit, same answer
+    merged, _, _ = _fold(store, base)
+    _assert_fold_equals(merged, truth)
+    assert tier.hits == 1
+
+    # manifest ranges prune: a disjoint range never touches the backend
+    misses = tier.misses
+    out = list(store.fetch_windows(base_dir=base, gadget="trace/lc",
+                                   start_ts=T0 + 9e6, end_ts=T0 + 9.1e6))
+    assert out == [] and tier.misses == misses
+
+
+def test_archive_corrupted_object_reported_never_merged(tmp_path):
+    store, base, store_dir, tier, truth, _ = _archived_store(tmp_path)
+    row = tier.manifest_rows(store_dir)[0]
+    # corrupt the object in the backend (bit flip mid-payload)
+    path = tier.backend._path(row["object"])
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+
+    losses: list = []
+    frames = list(store.fetch_windows(base_dir=base, gadget="trace/lc",
+                                      losses=losses))
+    assert frames == []  # the only segment was archived and is refused
+    assert any("digest mismatch" in loss["reason"] for loss in losses)
+    # and the refusal is typed in a query answer, never silently merged
+    ans = answer_query(decode_frames(frames))
+    assert ans.windows == 0
+
+
+def test_archive_manifest_torn_line_repaired_on_read(tmp_path):
+    """A crash/ENOSPC-torn archive.jsonl line must not hide every row
+    appended after it forever: manifest_rows repairs the file (atomic
+    rewrite of the good rows, the journal-index discipline) so later
+    offloads stay reachable."""
+    store, base, store_dir, tier, truth, _ = _archived_store(tmp_path)
+    mpath = os.path.join(store_dir, ARCHIVE_MANIFEST)
+    good = open(mpath, "rb").read()
+    with open(mpath, "ab") as f:
+        f.write(b'{"object": "torn-half')       # torn tail
+    assert len(tier.manifest_rows(store_dir)) == 1   # repair happened
+    # a row appended AFTER the (repaired) tear is visible again
+    from inspektor_gadget_tpu.utils.journal import append_line
+    append_line(mpath, {"schema": "x", "object": "o2", "file": "none",
+                        "bytes": 1, "digest": "d", "level": 1,
+                        "windows": 0, "first_seq": 0, "last_seq": 0,
+                        "first_ts": 0.0, "last_ts": 0.0, "keys": []})
+    rows = tier.manifest_rows(store_dir)
+    assert [r["object"] for r in rows][-1] == "o2"
+    assert open(mpath, "rb").read().startswith(good)
+    # and queries still answer identically through the surviving row
+    merged, _, _ = _fold(store, base)
+    _assert_fold_equals(merged, truth)
+
+
+def test_archive_cache_is_lru_bounded(tmp_path):
+    # two stores' worth of archived segments through one tiny cache
+    store, base, store_dir = _seed_store(tmp_path, n=12, rotate_every=3)
+    engine = CompactionEngine("10s@1m,60s@inf", store=store,
+                              clock=lambda: FUTURE)
+    engine.compact_store(store_dir)
+    writer = store.writer_for_dir(store_dir)
+    writer.rotate()
+    # archive each super-window segment; cache holds ~one segment
+    seg_size = max(
+        os.path.getsize(os.path.join(store_dir, f))
+        for f in os.listdir(store_dir) if f.startswith("seg-"))
+    store.set_archive(str(tmp_path / "objects"), seg_size + 128,
+                      base_dir=base)
+    tier = store.archive(base)
+    tier.archive_store(store_dir, min_level=1, writer=writer)
+    _fold(store, base)
+    cache_files = []
+    for root, _d, files in os.walk(tier.cache_dir):
+        cache_files += [os.path.join(root, f) for f in files]
+    used = sum(os.path.getsize(p) for p in cache_files)
+    assert used <= seg_size + 128 or len(cache_files) == 1
+    assert tier.misses >= 1
+
+
+# ---------------------------------------------------------------------------
+# QueryWindows pushdown + FetchWindows pagination (real gRPC agent)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def grpc_agent(tmp_path):
+    import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    from inspektor_gadget_tpu.agent.service import serve
+    from inspektor_gadget_tpu.history import HISTORY
+    base = str(tmp_path / "hist-area")
+    HISTORY.set_base_dir(base)
+    writer = HISTORY.writer_for("trace-lc", node="lcnode-0")
+    for i in range(6):
+        HISTORY.append_window(_window(i, node="lcnode-0"), writer=writer)
+    writer.rotate()
+    addr = f"unix://{tmp_path}/lc-agent.sock"
+    server, _agent = serve(addr, node_name="lcnode-0")
+    client = AgentClient(addr, "lcnode-0")
+    yield client, addr, base
+    client.close()
+    server.stop(grace=0.5)
+    HISTORY.close_all()
+    HISTORY.set_base_dir(None)
+    HISTORY.set_archive(None)
+
+
+def test_fetch_windows_pagination_edges(grpc_agent):
+    """Satellite: offset == N and offset > N return empty, well-formed
+    replies (not errors), and tiny max_bytes chunking drains every
+    window exactly once."""
+    from inspektor_gadget_tpu.agent import wire
+    client, _addr, _base = grpc_agent
+    method = client.channel.unary_unary(
+        "/igtpu.GadgetManager/FetchWindows",
+        request_serializer=wire.identity_serializer,
+        response_deserializer=wire.identity_deserializer)
+
+    def fetch(**kw):
+        h, payload = wire.decode_msg(method(
+            wire.encode_msg({"gadget": "trace/lc", **kw}),
+            timeout=10.0))
+        return h, payload
+
+    h, payload = fetch(offset=6)            # offset == N
+    assert h["ok"] and h["count"] == 0 and h["eof"] and payload == b""
+    h, payload = fetch(offset=7)            # offset == N + 1
+    assert h["ok"] and h["count"] == 0 and h["eof"] and payload == b""
+    h, payload = fetch(offset=10_000)       # offset far past
+    assert h["ok"] and h["count"] == 0 and h["eof"] and payload == b""
+    h, _ = fetch(offset="banana")           # malformed: typed, not a 500
+    assert "bad offset" in h["error"]
+
+    # chunk-boundary drain: every chunk under the budget, no window
+    # lost or duplicated, final chunk lands exactly on eof
+    frames, losses = client.fetch_windows(gadget="trace/lc",
+                                          chunk_bytes=1)
+    assert len(frames) == 6 and not losses
+    assert sorted(hh["window"] for hh, _p in frames) == [1, 2, 3, 4, 5, 6]
+    # and the one-shot path agrees
+    frames2, _ = client.fetch_windows(gadget="trace/lc")
+    assert [hh["digest"] for hh, _ in frames2] == \
+        [hh["digest"] for hh, _ in frames]
+
+
+def test_query_windows_pushdown_matches_fetch_and_fold(grpc_agent):
+    client, _addr, _base = grpc_agent
+    frames, _ = client.fetch_windows(gadget="trace/lc")
+    truth = merge_windows(decode_frames(frames))
+
+    res = client.query_windows(gadget="trace/lc")
+    assert res["folded"] == 6
+    assert res["levels"] == {0: 6}
+    assert res["torn"] == 0 and res["dropped"] == []
+    win = res["window"]
+    assert win is not None and win.node == "lcnode-0"
+    _assert_fold_equals(merge_windows([win]), truth)
+
+    # range + slice pushdown prunes node-side
+    res = client.query_windows(gadget="trace/lc", start_ts=T0 + 21.0,
+                               end_ts=T0 + 49.0)
+    assert res["folded"] == 3          # windows 3..5 overlap
+    res = client.query_windows(gadget="trace/lc", key="mntns:101")
+    assert res["folded"] == 3          # odd windows carry mntns:101
+    # no overlap: empty, well-formed
+    res = client.query_windows(gadget="trace/lc", start_ts=T0 + 9e6)
+    assert res["folded"] == 0 and res["window"] is None
+
+
+def test_query_history_pushdown_and_fallback_paths(grpc_agent):
+    import grpc
+
+    from inspektor_gadget_tpu.runtime.grpc_runtime import GrpcRuntime
+    client, addr, _base = grpc_agent
+    runtime = GrpcRuntime({"lcnode-0": addr})
+    try:
+        push = runtime.query_history(gadget="trace/lc")
+        assert push.paths == {"lcnode-0": "pushdown"}
+        assert push.windows == 6 and push.levels == {0: 6}
+
+        # an old agent answers UNIMPLEMENTED: the runtime falls back to
+        # list+fetch PER NODE and labels the path — answers identical
+        class OldAgentError(grpc.RpcError):
+            def code(self):
+                return grpc.StatusCode.UNIMPLEMENTED
+
+            def details(self):
+                return "Method not found"
+
+        c = runtime._client("lcnode-0")
+
+        def no_pushdown(**_kw):
+            raise OldAgentError()
+
+        c.query_windows = no_pushdown
+        fetch = runtime.query_history(gadget="trace/lc")
+        assert fetch.paths == {"lcnode-0": "fetch"}
+        assert fetch.windows == 6 and fetch.levels == {0: 6}
+        assert fetch.events == push.events
+        assert fetch.distinct == push.distinct
+        assert fetch.heavy_hitters == push.heavy_hitters
+        assert not fetch.errors and not push.errors
+    finally:
+        runtime.close()
+
+
+def test_dump_state_carries_history_tiers(grpc_agent):
+    client, _addr, _base = grpc_agent
+    tiers = client.dump_state().get("history_tiers")
+    assert tiers and tiers["stores"] == 1
+    assert tiers["levels"]["0"]["windows"] == 6
+    assert tiers["levels"]["0"]["bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Stats, CLI verbs, doctor row
+# ---------------------------------------------------------------------------
+
+def test_stats_reports_per_level_and_per_tier(tmp_path):
+    store, base, store_dir, tier, _truth, _ = _archived_store(tmp_path)
+    writer = store.writer_for_dir(store_dir)
+    store.append_window(_window(77, seed=77), writer=writer)  # fresh L0
+    stats = store.stats(base)
+    srow = stats["stores"]["lcnode--trace-lc"]
+    assert set(srow["levels"]) == {"0"}     # L1 windows are archived
+    l0 = srow["levels"]["0"]
+    assert l0["windows"] == 1 and l0["bytes"] > 0
+    assert l0["oldest_ts"] <= l0["newest_ts"]
+    assert srow["archive"]["segments"] == 1
+    assert srow["archive"]["windows"] == 3
+    tiers = store.tier_stats(base)
+    assert tiers["archived"]["segments"] == 1
+    assert tiers["archive_cache"]["budget"] == tier.cache_bytes
+
+
+def test_cli_history_verbs(tmp_path, capsys, monkeypatch):
+    from inspektor_gadget_tpu.cli.main import main as cli_main
+    store, base, store_dir = _seed_store(tmp_path, n=12)
+    monkeypatch.setenv("IG_HISTORY_DIR", base)
+
+    # compact: bad schedule is loud; good schedule folds and reports
+    assert cli_main(["history", "compact", "--history", base,
+                     "--schedule", "10m@1h,1m@inf"]) == 2
+    assert "strictly coarsen" in capsys.readouterr().err
+    # a single-level schedule never compacts: clean no-op, not a failure
+    assert cli_main(["history", "compact", "--history", base,
+                     "--schedule", "60s@inf"]) == 0
+    out = capsys.readouterr().out
+    assert "0 window(s) -> 0 super-window(s)" in out
+
+    # tiers: per-level table
+    assert cli_main(["history", "tiers", "--history", base]) == 0
+    out = capsys.readouterr().out
+    assert "level 0: 12 window(s)" in out
+
+    # archive with the default (schedule-derived) level: the store has
+    # no fully-final segments yet, so nothing moves — still rc 0
+    assert cli_main(["history", "archive", "--history", base,
+                     "--archive-dir", str(tmp_path / "obj"),
+                     "--schedule", "10s@1m,60s@inf"]) == 0
+    out = capsys.readouterr().out
+    assert "0 segment(s) archived" in out
+
+
+def test_query_cli_notes_compacted_resolution(tmp_path, capsys,
+                                              monkeypatch):
+    """Satellite: an answer that consulted compacted windows says so —
+    users aren't surprised by resolution loss."""
+    from inspektor_gadget_tpu.cli.main import main as cli_main
+    store, base, store_dir = _seed_store(tmp_path, n=12)
+    engine = CompactionEngine("10s@1m,60s@inf", store=store,
+                              clock=lambda: FUTURE)
+    engine.compact_store(store_dir)
+    monkeypatch.setenv("IG_HISTORY_DIR", base)
+    assert cli_main(["query", "--history", base,
+                     "--gadget", "trace/lc"]) == 0
+    out = capsys.readouterr().out
+    assert "compacted to coarser resolution" in out
+    assert "L1×3" in out
+    # JSON carries the breakdown
+    assert cli_main(["query", "--history", base, "--gadget", "trace/lc",
+                     "-o", "json"]) == 0
+    import json
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["levels"] == {"1": 3}
+    assert doc["compacted_windows"] == 3
+
+
+def test_history_bench_emits_schema_valid_records(tmp_path):
+    """The compaction + pushdown micro-bench points publish as
+    schema-valid PerfRecords (the ledger refuses anything else), so
+    `bench compare` can gate the lifecycle series like any other."""
+    from inspektor_gadget_tpu.perf.history_bench import publish
+    from inspektor_gadget_tpu.perf.ledger import read_ledger
+    from inspektor_gadget_tpu.perf.schema import validate_record
+    ledger = str(tmp_path / "PERF.jsonl")
+    records = publish(n_windows=16, ledger=ledger)
+    assert {r["config"] for r in records} == {"history-compaction",
+                                             "history-pushdown"}
+    for rec in records:
+        assert validate_record(rec) == []
+    push = next(r for r in records if r["config"] == "history-pushdown")
+    # the whole point of pushdown: strictly fewer bytes on the wire
+    assert push["extra"]["pushdown_wire_bytes"] \
+        < push["extra"]["fetch_wire_bytes"]
+    assert len(read_ledger(ledger).records) == 2
+
+
+def test_doctor_history_tiers_row(tmp_path, monkeypatch):
+    from inspektor_gadget_tpu.doctor import _probe_history_tiers
+    store, base, _store_dir = _seed_store(tmp_path, n=3)
+    monkeypatch.setenv("IG_HISTORY_DIR", base)
+    w = _probe_history_tiers()
+    assert w.ok
+    assert "L0: 3w" in w.detail
+    monkeypatch.setenv("IG_HISTORY_DIR", str(tmp_path / "empty"))
+    w = _probe_history_tiers()
+    assert w.ok and "no history stores" in w.detail

@@ -1,0 +1,415 @@
+"""Tiered-history acceptance tier (ISSUE 13):
+
+A 2-agent fleet seals ≥ 30 fine windows per node under a
+``fine@short,coarse@∞`` schedule; then
+
+(a) a fleet range query answered via QueryWindows PUSHDOWN returns one
+    merged window per node and matches the pre-compaction
+    fetch-and-fold ground truth — additive planes and HLL registers
+    exactly, top-k candidate sums exactly (both folds read the same
+    sealed candidate lists);
+(b) compaction shrinks the store's byte footprint and every source
+    window's seq/ts coverage lands in EXACTLY one super-window
+    (``compacted_from`` provenance audited);
+(c) a real SIGKILL mid-compaction (after the super-windows are durable,
+    before source GC) then reopen loses no coverage and double-counts
+    nothing — digest-audited, and the next pass converges;
+(d) archiving the cold level then querying an archived range rehydrates
+    through the manifest, digest-verified, and answers identically.
+
+Tests run in file order: each stage inspects the state the previous one
+left (fine windows → crashed compaction → finished compaction →
+archive), the way the lifecycle runs in production.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.agent.service import serve
+from inspektor_gadget_tpu.gadgets import GadgetContext
+from inspektor_gadget_tpu.gadgets import registry as gadget_registry
+from inspektor_gadget_tpu.gadgets.interface import GadgetDesc, GadgetType
+from inspektor_gadget_tpu.history import (
+    HISTORY,
+    CompactionEngine,
+    decode_frames,
+    dedupe_compacted,
+    merge_windows,
+)
+from inspektor_gadget_tpu.operators import operators as op_registry
+from inspektor_gadget_tpu.params import Collection, ParamDescs
+
+GADGET = "trace/tiersynth"
+N_WINDOWS = 32          # fine windows per node (>= 30 acceptance floor)
+BATCH = 256
+SCHEDULE = "1s@30s,120s@inf"       # fine@short,coarse@inf
+FAR = 1_000_000.0                   # age offset that outruns the horizon
+
+_RNG = np.random.default_rng(33)
+_PHASES = []
+for _i in range(N_WINDOWS):
+    a = (_RNG.zipf(1.5, size=BATCH // 2).clip(1, 64).astype(np.uint64)
+         * np.uint64(0x9E3779B97F4A7C15))
+    b = _RNG.integers(1, 2 ** 48, BATCH // 2).astype(np.uint64)
+    keys = np.concatenate([a, b])
+    mntns = np.concatenate([np.full(BATCH // 2, 101, np.uint64),
+                            np.full(BATCH // 2, 202, np.uint64)])
+    kind = np.concatenate([np.full(BATCH // 4, 10, np.uint32),
+                           np.full(BATCH // 4, 11, np.uint32),
+                           np.full(BATCH // 2, 11, np.uint32)])
+    _PHASES.append((keys, mntns, kind))
+
+
+class _TierSynthGadget:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._batch_handler = None
+
+    def set_batch_handler(self, handler):
+        self._batch_handler = handler
+
+    def run(self, ctx):
+        from inspektor_gadget_tpu.operators import tpusketch
+        from inspektor_gadget_tpu.sources.batch import EventBatch
+        inst = next((i for i in tpusketch.live_instances()
+                     if i.ctx.run_id == ctx.run_id), None)
+        for keys, mntns, kind in _PHASES:
+            if ctx.done:
+                return
+            b = EventBatch.alloc(len(keys), with_comm=False)
+            b.cols["key_hash"][:] = keys
+            b.cols["mntns"][:] = mntns
+            b.cols["kind"][:] = kind
+            b.cols["ts"][:] = time.time_ns()
+            b.count = len(keys)
+            if self._batch_handler is not None:
+                self._batch_handler(b)
+            if inst is not None:
+                inst.harvest()   # history-interval 0: one window/harvest
+            ctx.sleep_or_done(0.01)
+
+
+class _TierSynthDesc(GadgetDesc):
+    name = "tiersynth"
+    category = "trace"
+    gadget_type = GadgetType.TRACE
+    description = "scripted two-tenant batch gadget (tiers e2e)"
+    event_cls = None
+
+    def params(self) -> ParamDescs:
+        return ParamDescs()
+
+    def new_instance(self, ctx) -> _TierSynthGadget:
+        return _TierSynthGadget(ctx)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def synth_gadget():
+    desc = _TierSynthDesc()
+    gadget_registry.register(desc)
+    yield desc
+    gadget_registry._REGISTRY.pop((desc.category, desc.name), None)
+
+
+@pytest.fixture(scope="module")
+def agents():
+    servers, targets = [], {}
+    tmp = tempfile.mkdtemp()
+    for i in range(2):
+        addr = f"unix://{tmp}/tier-agent{i}.sock"
+        server, _ = serve(addr, node_name=f"tnode-{i}")
+        servers.append(server)
+        targets[f"tnode-{i}"] = addr
+    yield targets
+    for s in servers:
+        s.stop(grace=0.5)
+
+
+@pytest.fixture(scope="module")
+def history_area(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("tiers-area"))
+    HISTORY.set_base_dir(base)
+    yield base
+    HISTORY.close_all()
+    HISTORY.set_archive(None)
+    HISTORY.set_base_dir(None)
+
+
+def _op_params() -> Collection:
+    col = Collection()
+    sp = op_registry.get("tpusketch").instance_params().to_params()
+    for k, v in (("enable", "true"), ("depth", "4"), ("log2-width", "10"),
+                 ("hll-p", "10"), ("entropy-log2-width", "8"),
+                 ("topk", "32"), ("harvest-interval", "1h"),
+                 ("history", "true"), ("history-interval", "0"),
+                 ("history-log2-width", "10"), ("history-slots", "4")):
+        sp.set(k, v)
+    col["operator.tpusketch."] = sp
+    return col
+
+
+def _store_dir(base: str, node: str) -> str:
+    return os.path.join(base, f"{node}--trace-tiersynth")
+
+
+def _store_bytes(store_dir: str) -> int:
+    return sum(os.path.getsize(os.path.join(store_dir, f))
+               for f in os.listdir(store_dir) if f.startswith("seg-"))
+
+
+def _node_fold(base: str, node: str):
+    """fetch-and-fold through the store (the PR-6 ground-truth path),
+    deduped across tiers."""
+    frames = list(HISTORY.fetch_windows(base_dir=base, gadget=GADGET,
+                                        node=node))
+    kept, notes = dedupe_compacted(decode_frames(frames))
+    return merge_windows(kept), kept, notes
+
+
+@pytest.fixture(scope="module")
+def fleet_run(agents, history_area):
+    """Run the scripted gadget on both agents (history plane on), then
+    capture the PRE-COMPACTION ground truth: every node's decoded
+    windows, their fold, digests, and byte footprint."""
+    from inspektor_gadget_tpu.runtime.grpc_runtime import GrpcRuntime
+    runtime = GrpcRuntime(dict(agents))
+    try:
+        desc = gadget_registry.get("trace", "tiersynth")
+        ctx = GadgetContext(desc, operator_params=_op_params(),
+                            timeout=240.0)
+        run = runtime.run_gadget(ctx)
+        assert not run.errors(), run.errors()
+    finally:
+        runtime.close()
+    truth = {}
+    for node in agents:
+        merged, kept, notes = _node_fold(history_area, node)
+        assert notes == []
+        truth[node] = {
+            "merged": merged,
+            "digests": sorted(w.digest for w in kept),
+            "windows": len(kept),
+            "bytes": _store_bytes(_store_dir(history_area, node)),
+            "spans": sorted((w.start_ts, w.end_ts) for w in kept),
+        }
+    return truth
+
+
+def _assert_node_merge_equals(got, want):
+    """Additive planes + HLL registers exactly; candidate sums exactly
+    (both folds read the same sealed candidate lists); slice events and
+    slice HLLs exactly."""
+    assert got.events == want.events
+    assert got.drops == want.drops
+    assert np.array_equal(got.cms, want.cms)
+    assert np.array_equal(got.hll, want.hll)
+    # entropy buckets are integer-valued float32 deltas summed in
+    # float64: exact below 2^24 events, which 32×256 is
+    assert np.array_equal(got.ent, want.ent)
+    assert got.candidates == want.candidates
+    assert set(got.slices) == set(want.slices)
+    for skey, s in want.slices.items():
+        assert got.slices[skey]["events"] == s["events"]
+        assert np.array_equal(got.slices[skey]["hll"], s["hll"])
+
+
+def test_fleet_seals_fine_windows_per_node(fleet_run, agents):
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    for node, target in agents.items():
+        assert fleet_run[node]["windows"] >= 30
+        c = AgentClient(target, node)
+        try:
+            rows = c.list_windows(gadget=GADGET)["windows"]
+            assert len(rows) == N_WINDOWS
+            assert all(int(r.get("level", 0)) == 0 for r in rows)
+            assert {r["node"] for r in rows} == {node}
+        finally:
+            c.close()
+
+
+def test_sigkill_mid_compaction_loses_no_coverage(fleet_run, agents,
+                                                  history_area):
+    """(c) A REAL SIGKILL after the super-windows are durable and
+    before source GC: both tiers are on disk; queries dedup to
+    exactly-once; reopen + rerun converges with nothing lost."""
+    node = "tnode-0"
+    store_dir = _store_dir(history_area, node)
+    aged_clock = time.time() + FAR
+    child = subprocess.run([
+        sys.executable, "-c",
+        "import os, signal, sys\n"
+        "from inspektor_gadget_tpu.history import (CompactionEngine,\n"
+        "    HistoryStore)\n"
+        "store = HistoryStore(); store.set_base_dir(sys.argv[1])\n"
+        "eng = CompactionEngine(sys.argv[3], store=store,\n"
+        "                       clock=lambda: float(sys.argv[4]))\n"
+        "eng._before_gc = lambda: os.kill(os.getpid(), signal.SIGKILL)\n"
+        "eng.compact_store(sys.argv[2])\n",
+        history_area, store_dir, SCHEDULE, str(aged_clock),
+    ], timeout=120)
+    assert child.returncode == -signal.SIGKILL
+
+    # both tiers on disk: every source must fold exactly once
+    frames = list(HISTORY.fetch_windows(base_dir=history_area,
+                                        gadget=GADGET, node=node))
+    assert len(frames) > N_WINDOWS   # sources + durable super-windows
+    merged, kept, notes = _node_fold(history_area, node)
+    assert notes and all("superseded" in n for n in notes)
+    _assert_node_merge_equals(merged, fleet_run[node]["merged"])
+    # ... and the fleet query (pushdown, through the agent) agrees
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    c = AgentClient(agents[node], node)
+    try:
+        res = c.query_windows(gadget=GADGET)
+        assert res["dropped"] and res["window"] is not None
+        _assert_node_merge_equals(merge_windows([res["window"]]),
+                                  fleet_run[node]["merged"])
+    finally:
+        c.close()
+
+    # reopen (the writer the child mutated must be re-recovered) and
+    # finish: covered sources GC'd, nothing re-merged
+    HISTORY.close_all()
+    engine = CompactionEngine(SCHEDULE, clock=lambda: time.time() + FAR)
+    stats = engine.compact_store(store_dir)
+    assert stats["super_windows"] == 0
+    assert stats["segments_deleted"] >= 1
+    merged, kept, notes = _node_fold(history_area, node)
+    assert notes == []
+    assert all(w.level == 1 for w in kept)
+    _assert_node_merge_equals(merged, fleet_run[node]["merged"])
+
+
+def test_pushdown_after_compaction_matches_ground_truth(fleet_run,
+                                                        agents,
+                                                        history_area):
+    """(a) + (b): compact BOTH nodes, audit provenance and footprint,
+    then answer the fleet range query via QueryWindows pushdown — one
+    merged window per node, equal to the pre-compaction fetch-and-fold
+    ground truth."""
+    engine = CompactionEngine(SCHEDULE, clock=lambda: time.time() + FAR)
+    for node in agents:
+        store_dir = _store_dir(history_area, node)
+        engine.compact_store(store_dir)
+        # (b) byte footprint shrinks vs the fine-grained store
+        assert _store_bytes(store_dir) < fleet_run[node]["bytes"]
+        # (b) provenance audit: every fine window's digest in exactly
+        # one super-window, and the seq/ts coverage is complete
+        merged, kept, notes = _node_fold(history_area, node)
+        assert notes == []
+        assert kept and all(w.level == 1 for w in kept)
+        seen: dict[str, int] = {}
+        spans = []
+        for w in kept:
+            for row in w.compacted_from:
+                seen[row["digest"]] = seen.get(row["digest"], 0) + 1
+                spans.append((row["start_ts"], row["end_ts"]))
+        assert sorted(seen) == fleet_run[node]["digests"]
+        assert sorted(seen.values()) == [1] * N_WINDOWS
+        want_spans = fleet_run[node]["spans"]
+        assert sorted(spans) == want_spans
+        _assert_node_merge_equals(merged, fleet_run[node]["merged"])
+
+    # (a) the fleet query runs the pushdown path on every node: one
+    # merged window per node, O(nodes) on the wire
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    from inspektor_gadget_tpu.runtime.grpc_runtime import GrpcRuntime
+    for node, target in agents.items():
+        c = AgentClient(target, node)
+        try:
+            res = c.query_windows(gadget=GADGET)
+            assert res["window"] is not None
+            assert res["levels"] == {1: res["folded"]}
+            _assert_node_merge_equals(merge_windows([res["window"]]),
+                                      fleet_run[node]["merged"])
+        finally:
+            c.close()
+    runtime = GrpcRuntime(dict(agents))
+    try:
+        ans = runtime.query_history(gadget=GADGET)
+        assert ans.paths == {n: "pushdown" for n in agents}
+        assert sorted(ans.nodes) == sorted(agents)
+        assert not ans.errors
+        # consulted-windows accounting is all super-windows now
+        assert set(ans.levels) == {1}
+        assert ans.compacted_windows() == ans.windows
+        # additive planes exact vs ground truth
+        want_events = sum(fleet_run[n]["merged"].events for n in agents)
+        assert ans.events == want_events
+        # HLL max-merge is exact: the fleet estimate must equal the
+        # one computed from the pre-compaction per-node registers
+        from inspektor_gadget_tpu.history.window import slice_hll_estimate
+        gt_hll = np.maximum(fleet_run["tnode-0"]["merged"].hll,
+                            fleet_run["tnode-1"]["merged"].hll)
+        assert abs(ans.distinct - slice_hll_estimate(gt_hll)) < 1e-9
+    finally:
+        runtime.close()
+
+
+def test_archive_cold_level_and_query_rehydrates(fleet_run, agents,
+                                                 history_area,
+                                                 tmp_path_factory):
+    """(d): offload the (fully-compacted) cold level of one node to the
+    archive backend; a query overlapping the archived range rehydrates
+    through the manifest, digest-verified, and answers identically."""
+    node = "tnode-0"
+    store_dir = _store_dir(history_area, node)
+    archive_root = str(tmp_path_factory.mktemp("tiers-archive"))
+    HISTORY.set_archive(archive_root, 1 << 20)
+    tier = HISTORY.archive()
+    # compaction left the super-windows in a sealed segment; offload it
+    writer = HISTORY.writer_for_dir(store_dir)
+    writer.rotate()
+    stats = tier.archive_store(store_dir, min_level=1, writer=writer)
+    assert stats["segments"] >= 1 and stats["windows"] >= 1
+    rows = tier.manifest_rows(store_dir)
+    assert rows and all(r["digest"] for r in rows)
+    archived_files = {r["file"] for r in rows}
+    assert not any(os.path.isfile(os.path.join(store_dir, f))
+                   for f in archived_files)
+
+    # local fold rehydrates and answers identically (digest-verified)
+    merged, kept, notes = _node_fold(history_area, node)
+    assert notes == []
+    _assert_node_merge_equals(merged, fleet_run[node]["merged"])
+    assert tier.misses >= 1
+
+    # and the AGENT answers the same through QueryWindows pushdown —
+    # rehydration is node-side, the client never knows
+    from inspektor_gadget_tpu.agent.client import AgentClient
+    c = AgentClient(agents[node], node)
+    try:
+        res = c.query_windows(gadget=GADGET)
+        assert res["window"] is not None
+        _assert_node_merge_equals(merge_windows([res["window"]]),
+                                  fleet_run[node]["merged"])
+    finally:
+        c.close()
+
+    # a corrupted archive object is REPORTED, never silently merged:
+    # flip a byte in the backend, drop the cache, query again
+    obj_path = tier.backend._path(rows[0]["object"])
+    data = bytearray(open(obj_path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(obj_path, "wb").write(bytes(data))
+    HISTORY.set_archive(archive_root, 1 << 20)   # fresh tier, empty LRU
+    import shutil
+    shutil.rmtree(os.path.join(history_area, ".archive-cache"),
+                  ignore_errors=True)
+    losses: list = []
+    frames = list(HISTORY.fetch_windows(base_dir=history_area,
+                                        gadget=GADGET, node=node,
+                                        losses=losses))
+    assert any("digest mismatch" in loss["reason"] for loss in losses)
+    got = merge_windows(dedupe_compacted(decode_frames(frames))[0])
+    assert got.events < fleet_run[node]["merged"].events
