@@ -123,7 +123,7 @@ def host_plane_ev_per_s(batch: int = 1 << 17, seconds: float = 1.0) -> float:
     return n / (time.perf_counter() - t0)
 
 
-def run_child(platform: str) -> dict:
+def run_child(platform: str, chips: int = 1) -> dict:
     """The actual sketch pipeline. Runs in a subprocess; may hang if the
     backend does — the parent's timeout is the safety net."""
     import jax
@@ -258,6 +258,53 @@ def run_child(platform: str) -> dict:
     jax.block_until_ready(dbundle.events)
     device_ev_per_s = dsteps * batch / (time.perf_counter() - t0)
 
+    # ---- secondary: sharded device plane (--chips N, ISSUE 14) ------------
+    # the shard_map step over an N-lane (node) mesh on pre-staged arrays:
+    # per-round events = batch (split across lanes), so the ratio vs the
+    # single-chip device plane above isolates what the sharding machinery
+    # costs/buys at this scale point. Skipped (reported, not silent) when
+    # the host exposes fewer devices.
+    sharded_ev_per_s = None
+    sharded_err = ""
+    if chips > 1:
+        ndev = len(jax.devices())
+        if ndev < chips or batch % chips:
+            sharded_err = (f"chips={chips}: host has {ndev} device(s), "
+                           f"batch {batch} % chips must be 0")
+        else:
+            from inspektor_gadget_tpu.ops.sketches import (
+                bundle_stack_sharded, make_bundle_harvest_sharded,
+                make_bundle_ingest_sharded)
+            from inspektor_gadget_tpu.parallel.mesh import (NODE_AXIS,
+                                                            ingest_mesh)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            lane_n = batch // chips
+            mesh = ingest_mesh(chips)
+            like = new_bundle()
+            sstep = make_bundle_ingest_sharded(mesh, like)
+            sharvest = make_bundle_harvest_sharded(mesh, like)
+            stacked = bundle_stack_sharded(like, mesh)
+            sh = NamedSharding(mesh, P(NODE_AXIS))
+            gen_into(scratch)
+            keys = jax.device_put(
+                np.tile(scratch[:lane_n], chips).reshape(chips, lane_n), sh)
+            wts = jax.device_put(np.ones((chips, lane_n), np.uint32), sh)
+            dr = jax.device_put(np.zeros((chips,), np.float32), sh)
+            stacked, stok = sstep(stacked, keys, keys, keys, wts, dr)
+            jax.block_until_ready(stok)
+            ssteps = 0
+            t0 = time.perf_counter()
+            while True:
+                stacked, stok = sstep(stacked, keys, keys, keys, wts, dr)
+                ssteps += 1
+                if ssteps % 8 == 0:
+                    jax.block_until_ready(stok)
+                    if time.perf_counter() - t0 >= cfg["device_seconds"]:
+                        break
+            jax.block_until_ready(stok)
+            sharded_ev_per_s = ssteps * batch / (time.perf_counter() - t0)
+            jax.block_until_ready(sharvest(stacked).events)
+
     # ---- secondary: single-chip merge latency -----------------------------
     merge_jit = jax.jit(bundle_merge)
     other = new_bundle()
@@ -270,6 +317,13 @@ def run_child(platform: str) -> dict:
         jax.block_until_ready(m.events)
         times.append(time.perf_counter() - t0)
 
+    out_sharded: dict = {}
+    if sharded_ev_per_s is not None:
+        out_sharded = {"chips": chips,
+                       "device_plane_sharded_ev_per_s":
+                           round(sharded_ev_per_s, 1)}
+    elif sharded_err:
+        out_sharded = {"chips": chips, "sharded_error": sharded_err}
     return {
         "e2e_ev_per_s": round(e2e_ev_per_s, 1),
         "device_plane_ev_per_s": round(device_ev_per_s, 1),
@@ -277,6 +331,7 @@ def run_child(platform: str) -> dict:
         "platform": actual,
         "batch": batch,
         "gen_impl": gen_impl,
+        **out_sharded,
         # the child's live pipeline counters ride home with its result so
         # the parent's record carries them (the registry is per-process)
         "telemetry": T.snapshot(),
@@ -331,7 +386,8 @@ def _probe_with_retry() -> tuple[dict | None, str, list[dict]]:
     return None, perr, trail
 
 
-def main(forced: str | None = None, ledger: str | None = None) -> None:
+def main(forced: str | None = None, ledger: str | None = None,
+         chips: int = 1) -> None:
     # the impl placeholder is replaced with what the CHILD actually ran
     # (C++ SoA exporter or the py-fold fallback) once its result is in —
     # a py-fold record must never claim the native host plane
@@ -349,8 +405,10 @@ def main(forced: str | None = None, ledger: str | None = None) -> None:
     result = None
     errors = {}
     probe_trail: list[dict] = []
+    child_extra = [str(chips)] if chips > 1 else []
     if forced == "tpu":
-        result, terr = _spawn(["--child", "tpu"], TPU_CHILD_TIMEOUT_S)
+        result, terr = _spawn(["--child", "tpu", *child_extra],
+                              TPU_CHILD_TIMEOUT_S)
         if result is None:
             errors["tpu"] = terr
     elif forced != "cpu":
@@ -359,7 +417,8 @@ def main(forced: str | None = None, ledger: str | None = None) -> None:
         # accelerator — running the production shapes there would burn the
         # whole timeout (or mislabel a CPU run as tpu), so skip to fallback
         if probe and probe.get("ok") and probe.get("platform") != "cpu":
-            result, terr = _spawn(["--child", "tpu"], TPU_CHILD_TIMEOUT_S)
+            result, terr = _spawn(["--child", "tpu", *child_extra],
+                                  TPU_CHILD_TIMEOUT_S)
             if result is None:
                 errors["tpu"] = terr
         else:
@@ -367,7 +426,8 @@ def main(forced: str | None = None, ledger: str | None = None) -> None:
                 f"no accelerator (probe platform="
                 f"{probe.get('platform') if probe else None})")
     if result is None:
-        result, cerr = _spawn(["--child", "cpu"], CPU_CHILD_TIMEOUT_S)
+        result, cerr = _spawn(["--child", "cpu", *child_extra],
+                              CPU_CHILD_TIMEOUT_S)
         if result is None:
             errors["cpu"] = cerr
 
@@ -378,6 +438,9 @@ def main(forced: str | None = None, ledger: str | None = None) -> None:
         extra["device_plane_ev_per_s"] = result["device_plane_ev_per_s"]
         extra["merge_ms_p50"] = result["merge_ms_p50"]
         extra["batch"] = result["batch"]
+        for k in ("chips", "device_plane_sharded_ev_per_s", "sharded_error"):
+            if k in result:
+                extra[k] = result[k]
         extra["pipeline"] = extra["pipeline"].replace(
             "(?)", f"({result.get('gen_impl', 'unknown')})")
     else:
@@ -467,10 +530,20 @@ if __name__ == "__main__":
         print(json.dumps({"ok": True,
                           "platform": jax.devices()[0].platform}))
     elif len(sys.argv) > 1 and sys.argv[1] == "--child":
-        print(json.dumps(run_child(sys.argv[2])))
+        chips_arg = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+        print(json.dumps(run_child(sys.argv[2], chips_arg)))
     else:
         forced_arg = None
         ledger_arg = None
+        chips_cli = 1
+        if "--chips" in sys.argv:
+            i = sys.argv.index("--chips")
+            try:
+                chips_cli = int(sys.argv[i + 1])
+            except (IndexError, ValueError):
+                print("usage: bench.py [--platform auto|tpu|cpu] "
+                      "[--ledger PATH] [--chips N]", file=sys.stderr)
+                sys.exit(2)
         if "--platform" in sys.argv:
             i = sys.argv.index("--platform")
             forced_arg = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
@@ -487,4 +560,4 @@ if __name__ == "__main__":
                       "[--ledger PATH]", file=sys.stderr)
                 sys.exit(2)
             ledger_arg = sys.argv[i + 1]
-        main(forced_arg, ledger_arg)
+        main(forced_arg, ledger_arg, chips_cli)

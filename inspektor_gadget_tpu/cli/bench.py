@@ -51,12 +51,18 @@ def add_bench_parser(sub) -> None:
                          "the synthetic source (reproducible input; the "
                          "journal digest lands in the record provenance)")
     rp.add_argument("--pipeline", default="fused",
-                    choices=["fused", "classic"],
+                    choices=["fused", "classic", "sharded"],
                     help="hot-path shape: fused (pop_folded->h2d_overlap->"
-                         "fused_update, default) or classic (pop->decode->"
-                         "enrich->fold32->h2d->bundle_update); both append "
-                         "to the same ledger series, extra.pipeline says "
-                         "which ran")
+                         "fused_update, default), classic (pop->decode->"
+                         "enrich->fold32->h2d->bundle_update), or sharded "
+                         "(pop_folded->h2d_lanes->sharded_update over N "
+                         "device lanes); all append to the same ledger "
+                         "series discipline, extra.pipeline/extra.chips "
+                         "say which shape/scale ran")
+    rp.add_argument("--chips", type=int, default=1,
+                    help="device lanes for pipeline=sharded (1..local "
+                         "device count; the chips-scaling series names "
+                         "the scale point in extra.chips)")
     rp.add_argument("--no-ledger", action="store_true",
                     help="print the record without appending it")
     rp.add_argument("-o", "--output", default="json",
@@ -105,7 +111,8 @@ def cmd_bench_run(args) -> int:
             probe_horizon=args.probe_horizon,
             trace_out=args.trace_out or None,
             replay=args.replay or None,
-            pipeline=args.pipeline)
+            pipeline=args.pipeline,
+            chips=args.chips)
     except (ValueError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -468,6 +468,44 @@ def _probe_shared_runs() -> Window:
         return Window("shared_runs", False, repr(e))
 
 
+def _probe_device_topology() -> Window:
+    """Device-plane topology row (ISSUE 14): how many local chips the
+    sharded ingest plane can lane across, the (node) mesh shape it would
+    build, and whether `shard-ingest` is eligible (>= 2 devices).
+    Enumerating devices initializes the jax backend, so this probe only
+    READS a backend some other plane already paid to bring up — the
+    doctor must never be the thing that hangs on TPU acquisition (that
+    is the platform probe's bounded job). Merely having the jax MODULE
+    imported is not enough (the CLI imports it loading the operator
+    registry, long before any backend touch), so the gate is the
+    xla_bridge backend cache itself."""
+    try:
+        import sys
+        initialized = False
+        if "jax" in sys.modules:
+            try:
+                from jax._src import xla_bridge
+                initialized = bool(getattr(xla_bridge, "_backends", None))
+            except Exception:  # lint: allow-silent-except — internal-API probe; an unknown jax layout just reads as "not initialized", the safe answer
+                initialized = False
+        if not initialized:
+            return Window("device_topology", True,
+                          "jax backend not initialized in this process — "
+                          "topology unprobed (run a gadget or bench "
+                          "first)")
+        import jax
+        devs = jax.local_devices()
+        n = len(devs)
+        plat = devs[0].platform if devs else "none"
+        eligible = ("shard-ingest eligible" if n >= 2
+                    else "shard-ingest needs >= 2 devices")
+        return Window("device_topology", True,
+                      f"{n} local {plat} device(s), ingest mesh "
+                      f"(node={n}); {eligible}")
+    except Exception as e:  # noqa: BLE001
+        return Window("device_topology", False, repr(e))
+
+
 def _probe_mountinfo() -> Window:
     try:
         with open("/proc/self/mountinfo") as f:
@@ -495,7 +533,7 @@ _PROBES = (
     _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,
     _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
     _probe_history_dir, _probe_history_tiers, _probe_fleet_health,
-    _probe_shared_runs,
+    _probe_shared_runs, _probe_device_topology,
 )
 
 

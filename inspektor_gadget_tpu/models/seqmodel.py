@@ -34,6 +34,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel.compat import shard_map
+
 from ..parallel.flash_attention import flash_attention
 from ..parallel.ring_attention import (
     blockwise_attention, full_attention, ring_attention, ulysses_attention,
@@ -271,7 +273,7 @@ def make_sp_train_step(mesh: Mesh, cfg: SeqConfig, attn: str = "ring",
     opt = _optimizer(cfg)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(), P(None, axis)),
         out_specs=(P(), P(), P()))
     def step(params, opt_state, tokens):
@@ -330,7 +332,7 @@ def make_ep_train_step(mesh: Mesh, cfg: SeqConfig, scorer: SeqScorer,
     ospecs = seq_param_pspecs(scorer.opt_state, axis)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(pspecs, ospecs, P(axis)),
         out_specs=(pspecs, ospecs, P()))
     def step(params, opt_state, tokens):

@@ -17,6 +17,7 @@ qnames, `trace tcp` counts flows, with zero per-gadget code.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from pathlib import Path
@@ -34,9 +35,12 @@ from ..gadgets.interface import GadgetDesc
 from ..models.autoencoder import AEConfig, ae_init, ae_score, ae_train_step, normalize_counts
 from ..ops import bundle_init, fold64_to_32
 from ..ops.hll import hll_init, hll_update
-from ..ops.sketches import bundle_digest_jit, bundle_ingest_jit, decode_digest
+from ..ops.sketches import (bundle_digest_jit, bundle_ingest_jit,
+                            bundle_stack_sharded, decode_digest,
+                            make_bundle_harvest_sharded,
+                            make_bundle_ingest_sharded)
 from ..ops.window import wcms_advance, wcms_init, wcms_query, wcms_update
-from ..params import ParamDesc, ParamDescs, Params, TypeHint
+from ..params import ParamDesc, ParamDescs, ParamError, Params, TypeHint
 from ..params.validators import validate_int_range
 from ..sources.batch import EventBatch, FoldedBatch
 from ..sources.staging import H2DStager, PinnedBufferPool
@@ -53,6 +57,27 @@ _DEFAULT_SCHEDULE = "1m@24h,10m@7d,1h@inf"
 def _validate_history_schedule(value: str) -> None:
     from ..history import validate_schedule
     validate_schedule(value)
+
+
+def _validate_chips(value: str) -> None:
+    """`chips` is 'auto' (all local devices) or a positive int; the
+    against-this-host checks (> local devices, 1-device host) run at
+    instantiation, where the device count is known."""
+    if value == "auto":
+        return
+    try:
+        v = int(value)
+    except ValueError:
+        raise ValueError(f"{value!r} is not an integer or 'auto'") from None
+    if v < 1:
+        raise ValueError(f"chips must be >= 1, got {v}")
+
+
+def _local_device_count() -> int:
+    """Devices visible to the sharded ingest plane (module-level so tests
+    can pin a topology without owning real chips)."""
+    import jax
+    return jax.local_device_count()
 
 # device-plane telemetry (batch-grain; the histograms time dispatch-side —
 # device completion is async and surfaces in the next blocking read)
@@ -227,6 +252,22 @@ class TpuSketch(Operator):
                       description="H2D double-buffer depth: transfers of "
                                   "batch k+1..k+N-1 overlap device compute "
                                   "of batch k"),
+            # multi-chip sharded ingest (ISSUE 14): one fused bundle
+            # replica per chip, batches round-robined onto per-device
+            # lanes, psum/pmax collective merge at harvest only
+            ParamDesc(key="shard-ingest", default="false",
+                      type_hint=TypeHint.BOOL,
+                      description="shard the staged ingest plane across "
+                                  "local devices (round-robin lanes, "
+                                  "collective harvest; needs >= 2 local "
+                                  "devices; IG_SHARD_DISABLE=1 forces the "
+                                  "single-chip path)"),
+            ParamDesc(key="chips", default="auto",
+                      validator=_validate_chips,
+                      description="device lanes for shard-ingest: 'auto' "
+                                  "= all local devices; 1 = the exact "
+                                  "single-chip path; must not exceed the "
+                                  "local device count"),
             # sketch-history plane: seal one mergeable window per
             # boundary into the node's sealed-window store (history/)
             ParamDesc(key="history", default="false", type_hint=TypeHint.BOOL,
@@ -366,6 +407,66 @@ class TpuSketchInstance(OperatorInstance):
                            if "h2d-depth" in p else 2)
         self._pool: PinnedBufferPool | None = None
         self._stager: H2DStager | None = None
+        # -- multi-chip sharded ingest (ISSUE 14 tentpole) ----------------
+        # All topology checks answer a typed ParamError HERE, before the
+        # first batch (the FetchWindows loud-validation discipline):
+        # chips beyond the host, sharding a 1-device host, and a
+        # batch-size that can't fill whole rounds are config errors, not
+        # runtime surprises. chips=1 (or IG_SHARD_DISABLE=1) pins the
+        # EXACT single-chip PR-7 path — the shard machinery is never
+        # built, so there is zero regression risk behind the default.
+        self._shard_on = False
+        self._chips = 1
+        shard_req = (p.get("shard-ingest").as_bool()
+                     if "shard-ingest" in p else False)
+        chips_s = p.get("chips").as_string() if "chips" in p else "auto"
+        ndev = _local_device_count()
+        if os.environ.get("IG_SHARD_DISABLE", "") == "1":
+            # the escape hatch outranks every shard topology check: a
+            # fleet-wide config (chips=4) must still start on a host
+            # that degraded to fewer devices when the operator forces
+            # the single-chip path
+            if shard_req or chips_s != "auto":
+                _ckpt_log.warning(
+                    "IG_SHARD_DISABLE=1: shard-ingest/chips params are "
+                    "inert — forced to the single-chip path")
+            shard_req = False
+        elif chips_s != "auto" and int(chips_s) > ndev:
+            raise ParamError(
+                f"param 'chips': {chips_s} exceeds the {ndev} local "
+                f"device(s) on this host")
+        if shard_req:
+            if ndev < 2:
+                raise ParamError(
+                    "param 'shard-ingest': this host exposes 1 device — "
+                    "sharded ingest needs >= 2 local devices (chips=1 is "
+                    "the single-chip path and needs no flag)")
+            self._chips = ndev if chips_s == "auto" else int(chips_s)
+            if self._chips >= 2 and "batch-size" in ctx.gadget_params:
+                bs = ctx.gadget_params.get("batch-size").as_int()
+                if bs > 0 and bs % self._chips:
+                    raise ParamError(
+                        f"param 'chips': batch-size {bs} is not divisible "
+                        f"by chips {self._chips} — round-robin lane fills "
+                        f"need whole batches per lane")
+            self._shard_on = self._chips >= 2
+        # sharded state is built lazily at the first batch (mesh, jits,
+        # per-device pools). Round-robin assignment is the monotonic
+        # _next_lane counter — batch i ALWAYS lands on lane i mod chips,
+        # independent of when a harvest/checkpoint thread flushes the
+        # open round — and _pending maps lane → its staged-but-
+        # undispatched batch (staged arrays + stager slot + drops +
+        # window-plane fence tokens); a full round dispatches ONE
+        # shard_map step
+        self._mesh = None
+        self._sharded = None
+        self._ingest_sharded = None
+        self._harvest_sharded = None
+        self._lane_pools: list[PinnedBufferPool] = []
+        self._lane_stagers: list[H2DStager] = []
+        self._lane_zeros: list = []
+        self._next_lane = 0
+        self._pending: dict[int, dict] = {}
         # late-enrichment sample ring (display-only work moved OFF the
         # ingest path): per batch two vectorized slice writes capture a
         # few (k64, k32, comm) rows; names resolve lazily at harvest/seal
@@ -481,6 +582,147 @@ class TpuSketchInstance(OperatorInstance):
         self._pad = max(self._pad, pad)
         return self._pool, self._stager
 
+    # -- multi-chip sharded ingest plane (ISSUE 14) -------------------------
+
+    def _ensure_sharded(self) -> None:
+        """Build the (node) mesh, the shard_map ingest/harvest jits, and
+        the lane-stacked sharded bundle (lane 0 seeded with the resumed
+        single-chip state so checkpoint-resume semantics hold)."""
+        if self._sharded is not None:
+            return
+        from ..parallel.mesh import ingest_mesh
+        self._mesh = ingest_mesh(self._chips)
+        self._ingest_sharded = make_bundle_ingest_sharded(self._mesh,
+                                                          self.bundle)
+        self._harvest_sharded = make_bundle_harvest_sharded(self._mesh,
+                                                            self.bundle)
+        self._sharded = bundle_stack_sharded(self.bundle, self._mesh)
+
+    def _lane_staging(self, pad: int) -> tuple[PinnedBufferPool, H2DStager]:
+        """Pool + stager for the lane the NEXT batch lands on
+        (_next_lane — the monotonic round-robin counter, untouched by
+        concurrent flushes so assignment is a pure function of arrival
+        order). Per-lane pinned pools carry the lane label; per-lane
+        stagers pin their H2D to that lane's chip, so the transfer to
+        chip k+1 overlaps compute on chip k. A pad growth flushes the
+        open round at the OLD shape (rounds must be rectangular),
+        drains, and rebuilds every lane."""
+        self._ensure_sharded()
+        if not self._lane_pools or self._lane_pools[0].capacity != pad:
+            import jax
+            with self._bundle_mu:
+                self._flush_round_locked()
+            for st in self._lane_stagers:
+                st.drain()
+            devices = list(self._mesh.devices.reshape(-1))
+            self._lane_pools = [
+                PinnedBufferPool(pad, lanes=4,
+                                 max_free=self._h2d_depth + 2, lane=k)
+                for k in range(self._chips)]
+            self._lane_stagers = [
+                H2DStager(self._lane_pools[k], depth=self._h2d_depth,
+                          device=devices[k])
+                for k in range(self._chips)]
+            # one cached zero lane per chip: the filler a flushed
+            # partial round rides. Never donated (only the bundle is),
+            # so it is reusable forever; keeping fillers OFF the pools/
+            # stagers means the flush path (harvest/seal/checkpoint —
+            # possibly another thread) never touches staging state the
+            # capture thread mutates lock-free
+            self._lane_zeros = [
+                jax.device_put(np.zeros(pad, np.uint32), devices[k])
+                for k in range(self._chips)]
+        self._pad = max(self._pad, pad)
+        return (self._lane_pools[self._next_lane],
+                self._lane_stagers[self._next_lane])
+
+    def _shard_absorb_locked(self, hh_d, distinct_d, dist_d, w_d,
+                             new_drops: float, window_tokens: list,
+                             slot: int) -> None:
+        """Park one staged batch on its lane (the staged arrays already
+        live on that lane's chip; `slot` — captured at stage time —
+        names the stager slot to fence at dispatch) and advance the
+        round-robin counter; dispatch ONE sharded step when every lane
+        holds a batch. Caller holds _bundle_mu (pending state and the
+        sharded bundle move together)."""
+        lane = self._next_lane
+        self._pending[lane] = {
+            "arrays": (hh_d, distinct_d, dist_d, w_d),
+            "slot": slot,
+            "drops": max(new_drops, 0.0),
+            "fences": list(window_tokens),
+        }
+        self._next_lane = (self._next_lane + 1) % self._chips
+        if len(self._pending) >= self._chips:
+            self._dispatch_round_locked()
+
+    def _dispatch_round_locked(self) -> None:
+        """Assemble the pending lanes' staged arrays into global
+        node-sharded arrays (metadata only — the shards already live on
+        their chips) and run the shard_map ingest step. Lanes with no
+        pending batch (harvest/seal mid-round, ragged stream tails) ride
+        a zero-weight filler block: weight 0 contributes to no sketch
+        plane, so a flushed partial round folds exactly the batches it
+        holds. Fillers are the cached per-lane zero arrays — no pool
+        get, no staging, no stager state touched — so a flush from the
+        checkpointer/harvest thread never races the capture thread's
+        lock-free stage()/last_slot sequence. Each real batch is fenced
+        on ITS stager slot (captured at stage time)."""
+        if not self._pending:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import NODE_AXIS
+        pad = self._lane_pools[0].capacity
+        for lane in range(self._chips):
+            if lane in self._pending:
+                continue
+            z = self._lane_zeros[lane]
+            self._pending[lane] = {"arrays": (z, z, z, z), "slot": None,
+                                   "drops": 0.0, "fences": []}
+        sh = NamedSharding(self._mesh, P(NODE_AXIS))
+        by_lane = [self._pending[lane] for lane in range(self._chips)]
+
+        def global_of(i):
+            return jax.make_array_from_single_device_arrays(
+                (self._chips, pad), sh,
+                [p["arrays"][i].reshape(1, -1) for p in by_lane])
+
+        hh, distinct, dist, w = (global_of(i) for i in range(4))
+        devices = list(self._mesh.devices.reshape(-1))
+        drops = jax.make_array_from_single_device_arrays(
+            (self._chips,), sh,
+            [jax.device_put(np.asarray([p["drops"]], np.float32),
+                            devices[i])
+             for i, p in enumerate(by_lane)])
+        self._sharded, tok = self._ingest_sharded(
+            self._sharded, hh, distinct, dist, w, drops)
+        for lane, p in enumerate(by_lane):
+            # the global token waits for every lane's consumer (plus the
+            # lane's window-plane steps) before its block recycles;
+            # filler lanes (slot None) have no block to fence
+            if p["slot"] is not None:
+                self._lane_stagers[lane].fence_slot(
+                    p["slot"], tuple([tok] + p["fences"]))
+        self._pending = {}
+
+    def _flush_round_locked(self) -> None:
+        self._dispatch_round_locked()
+
+    def _merged_locked(self):
+        """The bundle every read path (harvest/seal/checkpoint/display)
+        consumes: the live single-chip bundle, or — under shard-ingest —
+        the collective harvest (psum/pmax + candidate re-rank) of the
+        lane-stacked bundle, after flushing any partial round so every
+        absorbed batch is visible. Bit-identical to the single-chip fold
+        of the same stream (tests/test_sharded_ingest.py). Caller holds
+        _bundle_mu."""
+        if not self._shard_on or self._sharded is None:
+            return self.bundle
+        self._flush_round_locked()
+        return self._harvest_sharded(self._sharded)
+
     def enrich_batch(self, batch: EventBatch) -> None:
         if not self.enabled or batch.count == 0:
             return
@@ -491,7 +733,8 @@ class TpuSketchInstance(OperatorInstance):
 
         t0 = time.perf_counter()
         with self._span("tpusketch/h2d", events=n, pad=pad):
-            pool, stager = self._staging_for(pad)
+            pool, stager = (self._lane_staging(pad) if self._shard_on
+                            else self._staging_for(pad))
             block = pool.get()
             lanes: dict[str, np.ndarray] = {}
 
@@ -522,6 +765,7 @@ class TpuSketchInstance(OperatorInstance):
             # the consumer fence below completes
             uniq = list(lanes.values())
             staged = stager.stage(block, uniq + [w])
+            staged_slot = stager.last_slot
             by_col = dict(zip(lanes.keys(), staged[:-1]))
             hh_d = by_col[self.hh_col]
             distinct_d = by_col[self.distinct_col]
@@ -530,27 +774,52 @@ class TpuSketchInstance(OperatorInstance):
         t1 = time.perf_counter()
         with self._span("tpusketch/update", events=n), \
                 device_annotation("ig:tpusketch_update"):
-            with self._bundle_mu:
-                self.bundle, tok = _ingest_jit(
-                    self.bundle, hh_d, distinct_d, dist_d, w_d,
-                    jnp.float32(max(new_drops, 0)),
-                )
-        fence = [tok]
-        if self._hist_on:
-            # window-plane device steps ride the same staged arrays: the
-            # WindowedCMS current slot and the per-window HLL absorb the
-            # batch so a seal reads window-only state
-            self._wcms, wtok = _wcms_ingest_jit(self._wcms, hh_d,
-                                                w_d.astype(jnp.int32))
-            self._win_hll, htok = _hll_ingest_jit(self._win_hll, distinct_d,
-                                                  w_d > 0)
-            self._accumulate_slices(batch, n, hh, distinct, dist)
-            fence += [wtok, htok]
-        # every consumer of the staged arrays is in the fence: the pinned
-        # block is reused only once they all completed (on CPU PJRT the
-        # device arrays may alias the host block, so transfer-complete
-        # alone is not enough)
-        stager.fence(tuple(fence))
+            if self._shard_on:
+                window_tokens = []
+                if self._hist_on:
+                    # the window plane stays single-chip: the staged
+                    # arrays live on this batch's lane chip, so the
+                    # WindowedCMS/HLL steps restage the HOST lane views
+                    # on the default device; their tokens join the lane's
+                    # round fence because on CPU PJRT these asarrays may
+                    # alias the pinned block
+                    self._wcms, wtok = _wcms_ingest_jit(
+                        self._wcms, jnp.asarray(hh),
+                        jnp.asarray(w).astype(jnp.int32))
+                    self._win_hll, htok = _hll_ingest_jit(
+                        self._win_hll, jnp.asarray(distinct),
+                        jnp.asarray(w) > 0)
+                    self._accumulate_slices(batch, n, hh, distinct, dist)
+                    window_tokens = [wtok, htok]
+                with self._bundle_mu:
+                    self._shard_absorb_locked(
+                        hh_d, distinct_d, dist_d, w_d,
+                        float(max(new_drops, 0)), window_tokens,
+                        staged_slot)
+            else:
+                with self._bundle_mu:
+                    self.bundle, tok = _ingest_jit(
+                        self.bundle, hh_d, distinct_d, dist_d, w_d,
+                        jnp.float32(max(new_drops, 0)),
+                    )
+                fence = [tok]
+                if self._hist_on:
+                    # window-plane device steps ride the same staged
+                    # arrays: the WindowedCMS current slot and the
+                    # per-window HLL absorb the batch so a seal reads
+                    # window-only state
+                    self._wcms, wtok = _wcms_ingest_jit(self._wcms, hh_d,
+                                                        w_d.astype(jnp.int32))
+                    self._win_hll, htok = _hll_ingest_jit(self._win_hll,
+                                                          distinct_d,
+                                                          w_d > 0)
+                    self._accumulate_slices(batch, n, hh, distinct, dist)
+                    fence += [wtok, htok]
+                # every consumer of the staged arrays is in the fence: the
+                # pinned block is reused only once they all completed (on
+                # CPU PJRT the device arrays may alias the host block, so
+                # transfer-complete alone is not enough)
+                stager.fence(tuple(fence))
         t2 = time.perf_counter()
         self._m_h2d.observe(t1 - t0)
         self._m_update.observe(t2 - t1)
@@ -592,32 +861,53 @@ class TpuSketchInstance(OperatorInstance):
         n = fb.count
         t0 = time.perf_counter()
         with self._span("tpusketch/h2d", events=n, pad=fb.capacity):
-            _pool, stager = self._staging_for(fb.capacity)
+            _pool, stager = (self._lane_staging(fb.capacity)
+                             if self._shard_on
+                             else self._staging_for(fb.capacity))
             if n < fb.capacity:
                 fb.keys[n:] = 0
                 fb.weights[n:] = 0
             new_drops = fb.drops - self._drops_seen
             self._drops_seen = fb.drops
             k_d, w_d = stager.stage(fb.lanes, (fb.keys, fb.weights))
+            staged_slot = stager.last_slot
         t1 = time.perf_counter()
         with self._span("tpusketch/update", events=n), \
                 device_annotation("ig:tpusketch_update"):
-            with self._bundle_mu:
-                self.bundle, tok = _ingest_jit(
-                    self.bundle, k_d, k_d, k_d, w_d,
-                    jnp.float32(max(new_drops, 0)))
-        fence = [tok]
-        if self._hist_on:
-            # same window-plane steps as enrich_batch: the WindowedCMS
-            # current slot and per-window HLL absorb the staged batch so
-            # interval seals read correct window-only state (minus
-            # slices — see the docstring)
-            self._wcms, wtok = _wcms_ingest_jit(self._wcms, k_d,
-                                                w_d.astype(jnp.int32))
-            self._win_hll, htok = _hll_ingest_jit(self._win_hll, k_d,
-                                                  w_d > 0)
-            fence += [wtok, htok]
-        stager.fence(tuple(fence))
+            if self._shard_on:
+                window_tokens = []
+                if self._hist_on:
+                    # single-chip window plane, restaged host views (see
+                    # enrich_batch) — sealed windows stay correct under
+                    # sharding, still minus slices on the folded path
+                    self._wcms, wtok = _wcms_ingest_jit(
+                        self._wcms, jnp.asarray(fb.keys),
+                        jnp.asarray(fb.weights).astype(jnp.int32))
+                    self._win_hll, htok = _hll_ingest_jit(
+                        self._win_hll, jnp.asarray(fb.keys),
+                        jnp.asarray(fb.weights) > 0)
+                    window_tokens = [wtok, htok]
+                with self._bundle_mu:
+                    self._shard_absorb_locked(
+                        k_d, k_d, k_d, w_d, float(max(new_drops, 0)),
+                        window_tokens, staged_slot)
+            else:
+                with self._bundle_mu:
+                    self.bundle, tok = _ingest_jit(
+                        self.bundle, k_d, k_d, k_d, w_d,
+                        jnp.float32(max(new_drops, 0)))
+                fence = [tok]
+                if self._hist_on:
+                    # same window-plane steps as enrich_batch: the
+                    # WindowedCMS current slot and per-window HLL absorb
+                    # the staged batch so interval seals read correct
+                    # window-only state (minus slices — see the docstring)
+                    self._wcms, wtok = _wcms_ingest_jit(self._wcms, k_d,
+                                                        w_d.astype(jnp.int32))
+                    self._win_hll, htok = _hll_ingest_jit(self._win_hll, k_d,
+                                                          w_d > 0)
+                    fence += [wtok, htok]
+                stager.fence(tuple(fence))
         t2 = time.perf_counter()
         self._m_h2d.observe(t1 - t0)
         self._m_update.observe(t2 - t1)
@@ -638,8 +928,14 @@ class TpuSketchInstance(OperatorInstance):
 
     def folded_block(self) -> np.ndarray:
         """A pinned (4, pad) staging block for pop_folded (rows 0..2 are
-        the keys/weights/mntns lanes; row 3 is unused padding)."""
-        pool, _ = self._staging_for(self._pad)
+        the keys/weights/mntns lanes; row 3 is unused padding). Under
+        shard-ingest the block comes from the pool of the lane the next
+        ingest_folded will land on, so it recycles through that lane's
+        ring."""
+        if self._shard_on:
+            pool, _ = self._lane_staging(self._pad)
+        else:
+            pool, _ = self._staging_for(self._pad)
         return pool.get()
 
     # -- late enrichment (off the ingest path) ------------------------------
@@ -790,10 +1086,11 @@ class TpuSketchInstance(OperatorInstance):
         from ..history import HISTORY, SealedWindow, window_digest
         end = self._hist_clock()
         with self._bundle_mu:
-            events = float(self.bundle.events)
-            drops = float(self.bundle.drops)
-            ent_now = np.asarray(self.bundle.entropy.counts).copy()
-            cand = np.asarray(self.bundle.topk.keys).copy()
+            b = self._merged_locked()
+            events = float(b.events)
+            drops = float(b.drops)
+            ent_now = np.asarray(b.entropy.counts).copy()
+            cand = np.asarray(b.topk.keys).copy()
         win_events = int(events - self._win_events0)
         if win_events <= 0 and not self._win_slices:
             self._win_start = end
@@ -886,9 +1183,11 @@ class TpuSketchInstance(OperatorInstance):
         # one packed digest: a single D2H transfer per tick, not 6 (each
         # read through the tunnel is tens of ms); dispatched under the
         # bundle lock so a concurrent update can't donate the buffers
-        # mid-read
+        # mid-read. Under shard-ingest _merged_locked flushes the open
+        # round and runs the collective harvest first — same digest, any
+        # chip count.
         with self._bundle_mu:
-            digest = bundle_digest_jit(self.bundle)
+            digest = bundle_digest_jit(self._merged_locked())
         events_f, drops_f, distinct, entropy_bits, keys, counts = (
             decode_digest(digest))
         order = np.argsort(-counts)
@@ -967,6 +1266,15 @@ class TpuSketchInstance(OperatorInstance):
                 # release every in-flight staging block (and zero the
                 # occupancy gauge) before the instance goes away
                 self._stager.drain()
+            if self._lane_stagers:
+                # sharded teardown: flush the open round (its batches
+                # must land before the final harvest above read them —
+                # _merged_locked already did; this is belt) and release
+                # every lane's in-flight blocks
+                with self._bundle_mu:
+                    self._flush_round_locked()
+                for st in self._lane_stagers:
+                    st.drain()
             self._stats.unregister()
             if _ckpt_dir is not None:
                 # shutdown save stays best-effort, but failures are now
@@ -1024,7 +1332,7 @@ class TpuSketchInstance(OperatorInstance):
         with self._span("tpusketch/checkpoint", key=self._ckpt_key), \
                 device_annotation("ig:tpusketch_checkpoint"):
             with self._bundle_mu:
-                bundle_host = jax.tree.map(np.asarray, self.bundle)
+                bundle_host = jax.tree.map(np.asarray, self._merged_locked())
                 scorer_host = (jax.tree.map(np.asarray, self.scorer)
                                if self.scorer is not None else None)
             save_pytree(base, bundle_host)
@@ -1035,7 +1343,8 @@ class TpuSketchInstance(OperatorInstance):
 
     def heavy_hitter_rows(self, resolve: Callable[[int], str] | None = None,
                           k: int = 20) -> list[HeavyHitterRow]:
-        b = self.bundle
+        with self._bundle_mu:
+            b = self._merged_locked()
         total = max(float(b.events), 1.0)
         rows = []
         keys = np.asarray(b.topk.keys)

@@ -201,6 +201,100 @@ def bundle_ingest_step(
 bundle_ingest_jit = jax.jit(bundle_ingest_step, donate_argnums=0)
 
 
+# -- multi-chip sharded ingest (ISSUE 14 tentpole) --------------------------
+# One fused SketchBundle replica per chip, stacked on a leading lane axis
+# and sharded over the (node) mesh: the ingest step is shard_map'd
+# bundle_update_fused with NO cross-chip traffic (each lane absorbs its
+# own staged batch), and the harvest is the only collective — psum for
+# the additive planes (CMS table/total, entropy counts, events, drops),
+# pmax for HLL registers, candidate union + re-rank against the merged
+# CMS for top-k. The merge algebra is the PR-6/7 one (cluster_merge),
+# so the harvested bundle is bit-identical to the single-chip fold of
+# the same event stream: integer adds commute, register max commutes,
+# and the top-k re-rank is a deterministic function of (candidate set,
+# merged CMS) — tests/test_sharded_ingest.py pins every leaf across
+# 1/2/4/8 lanes, ragged tails, and mid-run harvests.
+#
+# parallel.* imports stay inside the makers: parallel.cluster imports
+# THIS module, so a module-level import here would be a cycle (and the
+# makers run once per operator instance, not per batch).
+
+
+def bundle_stack_sharded(bundle: SketchBundle, mesh) -> SketchBundle:
+    """Stack `bundle` into lane 0 of a (chips, ...) lane-stacked bundle
+    (lanes 1..n-1 start empty) sharded over the mesh's node axis. Seeding
+    lane 0 with live state keeps checkpoint-resume semantics: the psum
+    harvest absorbs the resumed counts exactly once."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import NODE_AXIS
+    n = mesh.shape[NODE_AXIS]
+
+    def stack(x):
+        z = jnp.zeros((n,) + x.shape, x.dtype).at[0].set(x)
+        return jax.device_put(z, NamedSharding(mesh, P(NODE_AXIS)))
+
+    return jax.tree.map(stack, bundle)
+
+
+def _lane_specs(like: SketchBundle, spec):
+    return jax.tree.map(lambda _: spec, like)
+
+
+def make_bundle_ingest_sharded(mesh, like: SketchBundle):
+    """Jitted sharded ingest step: (stacked_bundle, hh, distinct, dist,
+    weights, drops) -> (stacked_bundle, fence_token).
+
+    Batch arrays are (chips, batch) sharded over the node axis; `drops`
+    is a (chips,) float32 lane vector. Each shard runs the SAME
+    bundle_update_fused step the single-chip path runs (weights-lane
+    semantics and the fused-vs-reference dispatch are inherited from
+    bundle_ingest_step / bundle_update_fused — one contract, every
+    path). The token is the per-lane events vector: fresh output each
+    step, never donated downstream, so every lane's H2DStager can fence
+    block recycling on it (the PR-7 fence contract, per lane)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.compat import shard_map
+    from ..parallel.mesh import NODE_AXIS
+
+    specs = _lane_specs(like, P(NODE_AXIS))
+    lane = P(NODE_AXIS)
+
+    def body(bund, hh, distinct, dist, weights, drops):
+        local = jax.tree.map(lambda x: x[0], bund)
+        out = bundle_update_fused(local, hh[0], distinct[0], dist[0],
+                                  weights[0].astype(jnp.int32), drops[0])
+        return jax.tree.map(lambda x: x[None], out), out.events[None]
+
+    return jax.jit(
+        shard_map(body, mesh=mesh,
+                  in_specs=(specs, lane, lane, lane, lane, lane),
+                  out_specs=(specs, lane), check_vma=False),
+        donate_argnums=0)
+
+
+def make_bundle_harvest_sharded(mesh, like: SketchBundle):
+    """Jitted collective harvest: lane-stacked sharded bundle -> ONE
+    replicated merged SketchBundle. The body IS parallel.cluster's
+    cluster_merge (psum CMS/entropy/events/drops, pmax HLL, all_gather +
+    re-rank top-k) — the same algebra the fleet merge uses, so device
+    counts cannot fork the math. Never donates: harvest reads the live
+    lane bundles while ingest keeps updating them."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.cluster import cluster_merge
+    from ..parallel.compat import shard_map
+    from ..parallel.mesh import NODE_AXIS
+
+    specs = _lane_specs(like, P(NODE_AXIS))
+    out_specs = _lane_specs(like, P())
+    return jax.jit(
+        shard_map(cluster_merge, mesh=mesh, in_specs=(specs,),
+                  out_specs=out_specs, check_vma=False),
+        donate_argnums=())
+
+
 def bundle_digest(b: SketchBundle) -> jnp.ndarray:
     """Harvest digest as ONE u32 array so a harvest tick costs a single
     D2H transfer instead of six (each device→host read through the axon

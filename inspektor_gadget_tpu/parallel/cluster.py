@@ -37,6 +37,7 @@ from ..ops.entropy import entropy_psum
 from ..ops.hll import hll_pmax
 from ..ops.sketches import SketchBundle, bundle_init, bundle_update
 from ..ops.topk import topk_gather_merge
+from .compat import shard_map
 from .mesh import MODEL_AXIS, NODE_AXIS
 
 
@@ -157,7 +158,7 @@ def make_cluster_step(mesh: Mesh, state: ClusterState):
 
     import functools
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             functools.partial(cluster_sketch_step, use_tp=use_tp),
             mesh=mesh,
             in_specs=(state_specs, batch_spec, batch_spec, batch_spec,
@@ -169,7 +170,7 @@ def make_cluster_step(mesh: Mesh, state: ClusterState):
     )
 
     merge = jax.jit(
-        jax.shard_map(
+        shard_map(
             cluster_merge,
             mesh=mesh,
             in_specs=(_specs_like(state.bundle, P(NODE_AXIS)),),

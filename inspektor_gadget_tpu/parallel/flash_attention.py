@@ -34,6 +34,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .compat import tpu_compiler_params
+
 _NEG = -1e30  # finite "-inf": keeps exp() exact-zero without NaNs
 
 
@@ -130,7 +132,7 @@ def _flash(q, k, v, causal: bool, block: int, scale: float,
             pltpu.VMEM((block, 1), jnp.float32),    # running denom l
             pltpu.VMEM((block, dp), jnp.float32),   # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)

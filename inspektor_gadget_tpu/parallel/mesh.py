@@ -47,6 +47,21 @@ def make_mesh(n_nodes: int | None = None, n_model: int = 1,
     return Mesh(devs, (NODE_AXIS, MODEL_AXIS))
 
 
+def ingest_mesh(chips: int, devices=None) -> Mesh:
+    """The (node)-only mesh the sharded ingest plane runs on (ISSUE 14):
+    `chips` local devices, one SketchBundle replica each, collectives only
+    at harvest. A 1-chip mesh is legal for the perf harness's scale-point
+    sweep; the operator short-circuits chips=1 to the unsharded path."""
+    if devices is None:
+        devices = jax.local_devices()
+    if chips < 1:
+        raise ValueError(f"chips must be >= 1, got {chips}")
+    if chips > len(devices):
+        raise ValueError(
+            f"chips={chips} exceeds the {len(devices)} local device(s)")
+    return Mesh(np.asarray(devices[:chips]), (NODE_AXIS,))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Event batches shard over the node axis (leading dim = node)."""
     return NamedSharding(mesh, P(NODE_AXIS))

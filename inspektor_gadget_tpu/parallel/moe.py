@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import shard_map
+
 EXPERT_AXIS = "expert"
 
 
@@ -142,7 +144,7 @@ def make_ep_moe(mesh: Mesh, n_experts: int, capacity_factor: float = 2.0,
         raise ValueError(f"n_experts={n_experts} not divisible by mesh axis {n}")
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(moe_pspecs(axis), P(axis)),
         out_specs=(P(axis), (P(), P())))
     def ep(params, x):

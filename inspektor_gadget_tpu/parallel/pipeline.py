@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import pcast_varying, shard_map
+
 STAGE_AXIS = "stage"
 
 
@@ -71,8 +73,12 @@ def make_pp_forward(mesh: Mesh, axis: str = STAGE_AXIS):
     s = mesh.shape[axis]
     perm = [(i, (i + 1) % s) for i in range(s)]
 
-    @functools.partial(jax.shard_map, mesh=mesh,
-                       in_specs=(pp_pspecs(axis), P()), out_specs=P())
+    # check_vma=False: the scan carry's varying-type bookkeeping differs
+    # between the 0.4 check_rep checker and the new vma one; the schedule
+    # itself is checked by the numerics tests (pp_forward == sequential)
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(pp_pspecs(axis), P()), out_specs=P(),
+                       check_vma=False)
     def fwd(params, x):
         stage = lax.axis_index(axis)
         m, mb, d = x.shape
@@ -94,7 +100,7 @@ def make_pp_forward(mesh: Mesh, axis: str = STAGE_AXIS):
             return (act, outbuf), None
 
         init = jax.tree.map(
-            lambda a: lax.pcast(a, (axis,), to="varying"),
+            lambda a: pcast_varying(a, (axis,)),
             (jnp.zeros((mb, d), jnp.float32), jnp.zeros_like(x)))
         (_, outbuf), _ = lax.scan(tick, init, jnp.arange(m + s - 1))
         # only the last stage holds real outputs; broadcast via masked psum

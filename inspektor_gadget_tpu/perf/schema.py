@@ -22,7 +22,7 @@ from typing import Any
 SCHEMA_ID = "ig-tpu/perf-record/v1"
 
 # canonical stage order of the ingest pipeline; records may carry any
-# subset. Two pipeline shapes share this table (the record's
+# subset. Three pipeline shapes share this table (the record's
 # extra.pipeline string says which one ran, so series keys — config +
 # metric + platform — never fork):
 #   classic: pop → decode → enrich → fold32 → h2d → bundle_update
@@ -30,15 +30,21 @@ SCHEMA_ID = "ig-tpu/perf-record/v1"
 #            zero-copy SoA exporter fills pinned blocks, the depth-N
 #            stager overlaps transfers with compute, and all sketch
 #            planes update in one fused device step)
+#   sharded: pop_folded → h2d_lanes → sharded_update   (ISSUE 14: the
+#            lane fill round-robins batches onto per-chip pinned rings,
+#            per-device H2D puts assemble into one node-sharded global,
+#            and ONE shard_map step updates every chip's fused bundle;
+#            harvest is the only collective)
 STAGES = ("pop", "decode", "enrich", "fold32", "pop_folded", "h2d",
-          "h2d_overlap", "bundle_update", "fused_update", "harvest",
-          "merge")
+          "h2d_overlap", "h2d_lanes", "bundle_update", "fused_update",
+          "sharded_update", "harvest", "merge")
 
 # stages whose seconds count as HOST-plane ingest cost (the acceptance
 # comparison pop_folded→h2d vs pop→decode→enrich→fold32 sums these)
 HOST_STAGES = {
     "classic": ("pop", "decode", "enrich", "fold32", "h2d"),
     "fused": ("pop_folded", "h2d_overlap"),
+    "sharded": ("pop_folded", "h2d_lanes"),
 }
 
 DIRECTIONS = ("higher_better", "lower_better")

@@ -31,15 +31,19 @@ from ..telemetry import counter, gauge
 
 # pinned-buffer-pool telemetry (ISSUE 10 satellite): a healthy steady
 # state is ~100% hits after warmup — misses in steady state mean the
-# pool is undersized and the allocator is back on the hot path
+# pool is undersized and the allocator is back on the hot path. The
+# `lane` label (ISSUE 14: one device lane per chip under sharded
+# ingest) keys each pool/stager to its chip; the single-chip path is
+# lane "0", so pre-sharding dashboards keep reading the same series.
 _tm_pool_hits = counter("ig_ingest_pool_hits_total",
-                        "staging blocks served from the pinned pool")
+                        "staging blocks served from the pinned pool",
+                        ("lane",))
 _tm_pool_misses = counter("ig_ingest_pool_misses_total",
                           "staging blocks freshly allocated (pool empty "
-                          "or shape mismatch)")
+                          "or shape mismatch)", ("lane",))
 _tm_inflight = gauge("ig_ingest_h2d_inflight",
                      "staged H2D transfers not yet fenced (double-buffer "
-                     "occupancy)")
+                     "occupancy)", ("lane",))
 
 
 def _alloc_pinned(lanes: int, capacity: int) -> np.ndarray:
@@ -70,10 +74,14 @@ class PinnedBufferPool:
     a burst allocates, steady state recycles.
     """
 
-    def __init__(self, capacity: int, lanes: int = 3, max_free: int = 8):
+    def __init__(self, capacity: int, lanes: int = 3, max_free: int = 8,
+                 lane: int | str = 0):
         self.capacity = int(capacity)
         self.lanes = int(lanes)
         self.max_free = int(max_free)
+        self.lane = str(lane)
+        self._hits = _tm_pool_hits.labels(lane=self.lane)
+        self._misses = _tm_pool_misses.labels(lane=self.lane)
         self._free: list[np.ndarray] = []
         self._mu = threading.Lock()
 
@@ -81,9 +89,9 @@ class PinnedBufferPool:
         with self._mu:
             if self._free:
                 blk = self._free.pop()
-                _tm_pool_hits.inc()
+                self._hits.inc()
                 return blk
-        _tm_pool_misses.inc()
+        self._misses.inc()
         return _alloc_pinned(self.lanes, self.capacity)
 
     def put(self, block: np.ndarray) -> None:
@@ -111,9 +119,15 @@ class H2DStager:
     only when it is >= depth batches ahead of the device.
     """
 
-    def __init__(self, pool: PinnedBufferPool, depth: int = 2):
+    def __init__(self, pool: PinnedBufferPool, depth: int = 2,
+                 device: Any | None = None):
         self.pool = pool
         self.depth = max(int(depth), 1)
+        # multi-lane mode (ISSUE 14): pin transfers to one chip so lane
+        # k+1's H2D overlaps lane k's compute; None keeps the default-
+        # device placement (the single-chip path, unchanged)
+        self.device = device
+        self._inflight = _tm_inflight.labels(lane=pool.lane)
         self._slots: list[tuple[np.ndarray, Any] | None] = [None] * self.depth
         self._i = 0
 
@@ -125,16 +139,28 @@ class H2DStager:
         old = self._slots[self._i]
         if old is not None:
             self._retire(old)
-        devs = tuple(jnp.asarray(a) for a in arrays)
-        _tm_inflight.inc()
+        if self.device is not None:
+            devs = tuple(jax.device_put(a, self.device) for a in arrays)
+        else:
+            devs = tuple(jnp.asarray(a) for a in arrays)
+        self._inflight.inc()
         self._slots[self._i] = (block, devs)
+        self.last_slot = self._i
         self._i = (self._i + 1) % self.depth
         return devs
 
     def fence(self, token: Any) -> None:
         """Attach the consumer's output to the most recently staged slot;
         its block is released only once `token` is ready."""
-        j = (self._i - 1) % self.depth
+        self.fence_slot((self._i - 1) % self.depth, token)
+
+    def fence_slot(self, j: int, token: Any) -> None:
+        """Fence a SPECIFIC slot (the `last_slot` captured at stage time).
+        The sharded ingest plane stages a lane, parks it in the open
+        round, and only learns its consumer token when the round
+        dispatches — by which point another thread's flush may have
+        staged a filler into the same stager, so "most recent" is not
+        necessarily the right slot."""
         slot = self._slots[j]
         if slot is not None:
             self._slots[j] = (slot[0], token)
@@ -143,7 +169,7 @@ class H2DStager:
         import jax
         block, fence = slot
         jax.block_until_ready(fence)
-        _tm_inflight.dec()
+        self._inflight.dec()
         self.pool.put(block)
 
     def drain(self) -> None:

@@ -225,6 +225,13 @@ class MetricFamily:
         return self._children[()].value
 
     @property
+    def total(self) -> float:
+        """Sum over every child's value — the label-agnostic read for
+        counter/gauge families (e.g. pool hits across all device lanes)."""
+        with self._lock:
+            return sum(c.value for c in self._children.values())
+
+    @property
     def count(self) -> int:
         return self._children[()].count
 

@@ -32,10 +32,36 @@ def test_probe_windows_names_and_shape():
                 "mountinfo", "procfs", "blktrace", "tcpinfo", "audit",
                 "captrace", "fstrace", "sockstate", "sigtrace",
                 "container_runtime", "capture_dir", "history_dir",
-                "history_tiers", "fleet_health", "shared_runs"}
+                "history_tiers", "fleet_health", "shared_runs",
+                "device_topology"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail
+
+
+def test_device_topology_row_agrees_with_probe():
+    """The device-plane topology row (ISSUE 14 satellite): the reported
+    device count, mesh shape, and shard-ingest eligibility must agree
+    with what jax actually exposes — the row is what an operator reads
+    before flipping `shard-ingest` on, so a row that disagrees with the
+    probe is worse than no row."""
+    import jax
+
+    from inspektor_gadget_tpu.doctor import _probe_device_topology
+
+    # the row only reads an ALREADY-initialized backend (it must never
+    # be the thing that hangs on TPU acquisition) — initialize here
+    jax.local_device_count()
+    w = _probe_device_topology()
+    assert w.ok
+    n = jax.local_device_count()
+    plat = jax.local_devices()[0].platform
+    assert f"{n} local {plat} device(s)" in w.detail
+    assert f"(node={n})" in w.detail
+    if n >= 2:
+        assert "shard-ingest eligible" in w.detail
+    else:
+        assert "needs >= 2 devices" in w.detail
 
 
 def test_history_dir_row_reports_writability_usage_and_free(monkeypatch,

@@ -22,15 +22,10 @@ WORKER = textwrap.dedent("""
     jax.config.update("jax_platforms", "cpu")
 
     coord, pid = sys.argv[1], int(sys.argv[2])
-    # shard_map moved to the jax namespace after 0.4.x and renamed
-    # check_rep -> check_vma; run against both
-    import inspect
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:
-        from jax.experimental.shard_map import shard_map
-    _smkw = ({"check_vma": False}
-             if "check_vma" in inspect.signature(shard_map).parameters
-             else {"check_rep": False})
+    # version drift (shard_map home + check flag) is resolved in ONE
+    # place now: the parallel.compat shim (ISSUE 14 satellite)
+    from inspektor_gadget_tpu.parallel.compat import shard_map
+    _smkw = {"check_vma": False}
     from inspektor_gadget_tpu.parallel.distributed import (
         init_distributed, make_multihost_mesh, world_size,
     )
@@ -114,13 +109,10 @@ ELASTIC_WORKER = textwrap.dedent("""
 
     coord_a, coord_b, pid, tmpdir = (
         sys.argv[1], sys.argv[2], int(sys.argv[3]), sys.argv[4])
-    import inspect
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:
-        from jax.experimental.shard_map import shard_map
-    _smkw = ({"check_vma": False}
-             if "check_vma" in inspect.signature(shard_map).parameters
-             else {"check_rep": False})
+    # version drift (shard_map home + check flag) is resolved in ONE
+    # place now: the parallel.compat shim (ISSUE 14 satellite)
+    from inspektor_gadget_tpu.parallel.compat import shard_map
+    _smkw = {"check_vma": False}
     from inspektor_gadget_tpu.parallel.distributed import (
         init_distributed, make_multihost_mesh, world_size,
     )

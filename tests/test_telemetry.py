@@ -421,26 +421,80 @@ def test_ingest_pool_counters_and_inflight_gauge(sketch_instance):
     from inspektor_gadget_tpu.telemetry import render_prometheus
 
     _tmp, inst = sketch_instance
-    hits0 = staging._tm_pool_hits.value
-    miss0 = staging._tm_pool_misses.value
-    inflight0 = staging._tm_inflight.value
+    # the single-chip path stages through lane "0" (ISSUE 14 relabel:
+    # the families grew a `lane` label; .total sums across lanes)
+    hits0 = staging._tm_pool_hits.total
+    miss0 = staging._tm_pool_misses.total
+    lane0_hits0 = staging._tm_pool_hits.labels(lane="0").value
+    inflight0 = staging._tm_inflight.total
 
     src = PySyntheticSource(seed=5, batch_size=512)
     for _ in range(8):
         inst.enrich_batch(src.generate(512))
-    assert staging._tm_pool_misses.value > miss0, \
+    assert staging._tm_pool_misses.total > miss0, \
         "first staging blocks must be accounted as pool misses"
-    assert staging._tm_pool_hits.value > hits0, \
+    assert staging._tm_pool_hits.total > hits0, \
         "steady-state ingest must recycle pinned blocks (pool hits)"
+    assert staging._tm_pool_hits.labels(lane="0").value > lane0_hits0, \
+        "the unsharded path must stay on lane 0 of the labeled series"
     assert inst._stager is not None
     inst._stager.drain()
-    assert staging._tm_inflight.value == inflight0, \
+    assert staging._tm_inflight.total == inflight0, \
         "drained stager must return the in-flight gauge to baseline"
 
     text = render_prometheus()
     assert "ig_ingest_pool_hits_total" in text
     assert "ig_ingest_pool_misses_total" in text
     assert "ig_ingest_h2d_inflight" in text
+
+
+def test_sharded_lane_pool_telemetry_and_gauge_drain():
+    """ISSUE 14 satellite: under shard-ingest every device lane accounts
+    its OWN pinned pool — lane-labeled miss-then-hit progressions per
+    lane, a lane-labeled in-flight gauge that returns to baseline when
+    the instance tears down — and the lane label reaches the Prometheus
+    exposition."""
+    from inspektor_gadget_tpu.operators.operators import get as get_op
+    from inspektor_gadget_tpu.sources import staging
+    from inspektor_gadget_tpu.sources.synthetic import PySyntheticSource
+    from inspektor_gadget_tpu.telemetry import render_prometheus
+
+    desc = get("trace", "exec")
+    ctx = GadgetContext(desc)
+    op = get_op("tpusketch")
+    p = op.instance_params().to_params()
+    p.set("enable", "true")
+    p.set("log2-width", "8")
+    p.set("hll-p", "6")
+    p.set("entropy-log2-width", "6")
+    p.set("topk", "8")
+    p.set("shard-ingest", "true")
+    p.set("chips", "2")
+    inst = op.instantiate(ctx, None, p)
+    assert inst._shard_on
+
+    base = {k: (staging._tm_pool_hits.labels(lane=str(k)).value,
+                staging._tm_pool_misses.labels(lane=str(k)).value)
+            for k in (0, 1)}
+    inflight0 = staging._tm_inflight.total
+
+    src = PySyntheticSource(seed=9, batch_size=512)
+    for _ in range(8):
+        inst.enrich_batch(src.generate(512))
+    for k in (0, 1):
+        h0, m0 = base[k]
+        assert staging._tm_pool_misses.labels(lane=str(k)).value > m0, \
+            f"lane {k}: first blocks must be accounted as misses"
+        assert staging._tm_pool_hits.labels(lane=str(k)).value > h0, \
+            f"lane {k}: steady state must recycle that lane's blocks"
+    inst.harvest()
+    inst.post_gadget_run()
+    assert staging._tm_inflight.total == inflight0, \
+        "teardown must return every lane's in-flight gauge to baseline"
+
+    text = render_prometheus()
+    assert 'ig_ingest_pool_hits_total{lane="1"}' in text
+    assert 'ig_ingest_h2d_inflight{lane="1"}' in text
 
 
 def test_ingest_folded_roundtrip_recycles_blocks(sketch_instance):
