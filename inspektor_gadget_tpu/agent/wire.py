@@ -109,6 +109,22 @@ def encode_summary(summary) -> tuple[dict, bytes]:
         "anomaly": summary.anomaly,
         "names": {str(k): v for k, v in (summary.names or {}).items()},
     }
+    # invertible-plane / candidate-ring accounting (ISSUE 15): only when
+    # present, so pre-plane consumers see byte-identical headers. The
+    # decoded lists are CAPPED here (count-descending, so the cap keeps
+    # the heaviest): the in-process summary carries the full recovery
+    # for the local alert engine, but a JSON header must stay bounded —
+    # summary.inv.recovered reports the uncapped total either way.
+    if getattr(summary, "approx", False):
+        header["approx"] = True
+    for field, cap in (("decoded", 256), ("decoded_only", 64)):
+        rows = getattr(summary, field, None)
+        if rows:
+            header[field] = [[int(k), int(c)] for k, c in rows[:cap]]
+    for field in ("inv", "classes"):
+        v = getattr(summary, field, None)
+        if v is not None:
+            header[field] = v
     arr = np.asarray(summary.heavy_hitters, dtype=np.int64)
     buf = io.BytesIO()
     np.save(buf, arr)

@@ -194,6 +194,24 @@ class AlertEngine:
                 return [("", 0.0, False)]
             jaccard_dist = 1.0 - len(prev & cur) / len(prev | cur)
             return [("", jaccard_dist, jaccard_dist > rule.threshold)]
+        if rule.kind == "heavy_flow":
+            # one state machine per DECODED key (invertible plane): the
+            # counts are exact recoveries from merged sketch state, so a
+            # firing names the offending flow itself — keys that stop
+            # decoding resolve via the vanished-key sweep below. A
+            # decode can recover tens of thousands of keys (every
+            # count-1 singleton under capacity), so only keys that
+            # TRIGGER — or already hold live state (hysteresis/`clear`
+            # must keep seeing values below the trigger) — get a state
+            # machine; everything else is skipped before allocation
+            from .rules import decoded_pairs
+            out = []
+            for k, c in sorted(decoded_pairs(summary)):
+                key = f"key:0x{k:08x}"
+                trig = _cmp(rule.op, float(c), rule.threshold)
+                if trig or key in rs.keys:
+                    out.append((key, float(c), trig))
+            return out
         # anomaly_score: one state machine per container slot
         anomaly = (summary.get("anomaly") if isinstance(summary, dict)
                    else summary.anomaly) or {}

@@ -38,14 +38,24 @@ import json
 from ..params.validators import parse_duration
 
 KINDS = ("threshold", "ratio", "entropy_jump", "cardinality_spike",
-         "heavy_hitter_churn", "anomaly_score")
+         "heavy_hitter_churn", "anomaly_score", "heavy_flow")
 SEVERITIES = ("info", "warning", "critical")
 OPS = (">", ">=", "<", "<=")
 
 # numeric summary fields a threshold/ratio rule may reference; the single
 # access point (summary_fields) keeps rules and the harvest shape in sync
 SUMMARY_FIELDS = ("events", "drops", "distinct", "entropy_bits",
-                  "hh_top_count", "hh_top_share", "hh_count", "anomaly_max")
+                  "hh_top_count", "hh_top_share", "hh_count", "anomaly_max",
+                  "decoded_count")
+
+
+def decoded_pairs(summary) -> list[tuple[int, int]]:
+    """The invertible plane's decoded (key32, exact count) pairs from a
+    SketchSummary or its wire-decoded dict shape — one access point, like
+    summary_fields. Empty when the plane is off."""
+    rows = (summary.get("decoded") if isinstance(summary, dict)
+            else getattr(summary, "decoded", None)) or []
+    return [(int(k), int(c)) for k, c in rows]
 
 
 def summary_fields(summary) -> dict[str, float]:
@@ -75,6 +85,7 @@ def summary_fields(summary) -> dict[str, float]:
         "hh_top_share": top_count / events if events > 0 else 0.0,
         "hh_count": float(len(hh)),
         "anomaly_max": max((float(v) for v in anomaly.values()), default=0.0),
+        "decoded_count": float(len(decoded_pairs(summary))),
     }
 
 
@@ -109,6 +120,9 @@ class AlertRule:
             cond = f"distinct > {self.factor:g}x mean(last {self.window})"
         elif self.kind == "heavy_hitter_churn":
             cond = f"topk jaccard-dist > {self.threshold:g}"
+        elif self.kind == "heavy_flow":
+            cond = (f"decoded[key] {self.op} {self.threshold:g} "
+                    "(invertible plane, exact counts)")
         else:  # anomaly_score
             cond = f"anomaly[mntns] {self.op} {self.threshold:g}"
         return (f"{self.id}: {cond} for {self.for_s:g}s "
@@ -183,6 +197,9 @@ def _parse_rule(raw: object, index: int) -> AlertRule:
         if field not in SUMMARY_FIELDS:
             raise RuleError(f"rule {rid!r}: unknown summary field {field!r} "
                             f"(one of {list(SUMMARY_FIELDS)})")
+    elif kind == "heavy_flow" and field:
+        raise RuleError(f"rule {rid!r}: kind 'heavy_flow' evaluates the "
+                        f"decoded key counts; remove field={field!r}")
 
     denom = raw.get("denom", "")
     if kind == "ratio":

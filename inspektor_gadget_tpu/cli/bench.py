@@ -63,6 +63,12 @@ def add_bench_parser(sub) -> None:
                     help="device lanes for pipeline=sharded (1..local "
                          "device count; the chips-scaling series names "
                          "the scale point in extra.chips)")
+    rp.add_argument("--invertible", action="store_true",
+                    help="enable the invertible heavy-key plane in the "
+                         "measured bundle (extra kernel planes on the "
+                         "fused path; adds inv_update/inv_decode stages; "
+                         "extra.invertible marks the record, series "
+                         "unforked)")
     rp.add_argument("--no-ledger", action="store_true",
                     help="print the record without appending it")
     rp.add_argument("-o", "--output", default="json",
@@ -112,7 +118,8 @@ def cmd_bench_run(args) -> int:
             trace_out=args.trace_out or None,
             replay=args.replay or None,
             pipeline=args.pipeline,
-            chips=args.chips)
+            chips=args.chips,
+            invertible=args.invertible)
     except (ValueError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

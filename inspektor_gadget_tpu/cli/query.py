@@ -132,6 +132,21 @@ def _print_answer(answer, *, key: str | None, show_slices: bool,
         print("heavy hitters:")
         for k32, count, label in answer.heavy_hitters[:top]:
             print(f"  {label:<24s}  {count:>12,}")
+    if answer.heavy_flows:
+        inv = answer.inv or {}
+        cov = ("complete" if inv.get("complete")
+               else f"partial ({inv.get('residual_events', 0)} events "
+                    "undecoded)")
+        print(f"heavy flows (invertible decode, exact counts, {cov}):")
+        for k32, count, label in answer.heavy_flows[:top]:
+            print(f"  {label:<24s}  {count:>12,}")
+        if answer.decoded_only:
+            # the observable win over the candidate ring: keys recovered
+            # from merged state that no node's tracker ever surfaced
+            print(f"decode recovered {len(answer.decoded_only)} key(s) "
+                  "the candidate ring missed:")
+            for k32, count, label in answer.decoded_only[:top]:
+                print(f"  {label:<24s}  {count:>12,}")
     wanted = ([key] if key else
               (sorted(answer.slices) if show_slices else []))
     for skey in wanted:

@@ -81,6 +81,17 @@ class QueryAnswer:
     # fallback for old agents), or "local".
     levels: dict[int, int] = dataclasses.field(default_factory=dict)
     paths: dict[str, str] = dataclasses.field(default_factory=dict)
+    # invertible-plane decode of the merged range (ISSUE 15): exact
+    # (key32, count, label) rows recovered from merged state alone, the
+    # subset of them the candidate ring missed (decoded_only — the
+    # observable win over tracked candidates), and the decode's
+    # completeness accounting; all empty/None when the range's windows
+    # don't (all) carry the plane
+    heavy_flows: list[tuple[int, int, str]] = dataclasses.field(
+        default_factory=list)
+    decoded_only: list[tuple[int, int, str]] = dataclasses.field(
+        default_factory=list)
+    inv: dict | None = None
 
     def compacted_windows(self) -> int:
         """How many folded windows were coarser than native resolution."""
@@ -99,6 +110,13 @@ class QueryAnswer:
             "heavy_hitters": [
                 {"key": f"0x{k:08x}", "count": c, "label": label}
                 for k, c, label in self.heavy_hitters],
+            "heavy_flows": [
+                {"key": f"0x{k:08x}", "count": c, "label": label}
+                for k, c, label in self.heavy_flows],
+            "decoded_only": [
+                {"key": f"0x{k:08x}", "count": c, "label": label}
+                for k, c, label in self.decoded_only],
+            "inv": self.inv,
             "slices": self.slices,
             "dropped_windows": self.dropped_windows,
             "errors": self.errors,
@@ -173,6 +191,21 @@ def answer_query(windows: Iterable[SealedWindow], *,
     labels = merged.names
     hh = [(k, c, labels.get(k, f"0x{k:08x}"))
           for k, c in merged.heavy_hitters(top)]
+    # invertible plane: decode the merged range (exact counts, no
+    # per-key storage) and report what the candidate ring missed
+    flows: list[tuple[int, int, str]] = []
+    decoded_only: list[tuple[int, int, str]] = []
+    inv_info = None
+    dec = merged.heavy_flow_decode()
+    if dec is not None:
+        flows = [(k, c, labels.get(k, f"0x{k:08x}"))
+                 for k, c in dec.top(top)]
+        ring = set(merged.candidates)
+        decoded_only = [(k, c, labels.get(k, f"0x{k:08x}"))
+                        for k, c in dec.keys if k not in ring][:top]
+        inv_info = {"recovered": dec.recovered,
+                    "complete": dec.complete,
+                    "residual_events": dec.residual_events}
     slices: dict[str, dict] = {}
     for skey in ([key] if key else sorted(merged.slices)):
         ans = merged.slice_answer(skey)
@@ -204,6 +237,9 @@ def answer_query(windows: Iterable[SealedWindow], *,
         errors=dict(errors or {}),
         levels=dict(levels) if levels is not None else level_counts(kept),
         paths=dict(paths or {}),
+        heavy_flows=flows,
+        decoded_only=decoded_only,
+        inv=inv_info,
     )
 
 

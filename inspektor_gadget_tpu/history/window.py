@@ -37,6 +37,11 @@ from typing import Iterable
 
 import numpy as np
 
+# the host-side murmur3 twin lives in ONE place (ops.hashing.fmix32_np,
+# bit-identical to the device fmix32) so slice sketches and the
+# invertible decode can never fork their hash family
+from ..ops.hashing import fmix32_np as _fmix32_np
+
 WINDOW_SCHEMA = "ig-tpu/sketch-window/v1"
 
 # slice-plane geometry: small on purpose — a window carries up to
@@ -46,17 +51,6 @@ SLICE_ENT_LOG2_WIDTH = 6   # 64 buckets per slice
 SLICE_HH_K = 32            # exact truncated heavy-hitter table per slice
 
 
-def _fmix32_np(h: np.ndarray) -> np.ndarray:
-    """murmur3 finalizer on uint32 numpy lanes — the host-side twin of
-    ops.hashing.fmix32, kept bit-identical so slice sketches built on
-    any node merge coherently."""
-    h = h.astype(np.uint32)
-    h ^= h >> np.uint32(16)
-    h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
-    h ^= h >> np.uint32(13)
-    h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
-    h ^= h >> np.uint32(16)
-    return h
 
 
 @dataclasses.dataclass
@@ -158,6 +152,16 @@ class SealedWindow:
     # at query time (the source's digest is in exactly one list).
     level: int = 0
     compacted_from: list[dict] = dataclasses.field(default_factory=list)
+    # -- invertible heavy-key plane (ISSUE 15) ----------------------------
+    # Per-window deltas of the bundle's invertible lanes (count int32,
+    # keysum/fpsum uint32, all (rows, buckets)); None for configs without
+    # the plane, and absent fields never enter the digest — pre-ISSUE-15
+    # window digests are unchanged. Merge is elementwise add (wrap is
+    # the algebra), so decoding a MERGED range recovers the range's
+    # heavy keys exactly like live merged state does.
+    inv_count: np.ndarray | None = None
+    inv_keysum: np.ndarray | None = None
+    inv_fpsum: np.ndarray | None = None
 
     @property
     def slice_keys(self) -> list[str]:
@@ -185,6 +189,12 @@ def window_digest(win: SealedWindow) -> str:
         # lists are trimmed/audited without changing state identity).
         # Level 0 omits the field so pre-tier digests stay reproducible.
         **({"level": int(win.level)} if win.level else {}),
+        # invertible plane: present only when sealed with it, so digests
+        # of plane-off configs (and all pre-plane history) are unchanged
+        **({"inv_count": arr(win.inv_count),
+            "inv_keysum": arr(win.inv_keysum),
+            "inv_fpsum": arr(win.inv_fpsum)}
+           if win.inv_count is not None else {}),
         "cms": arr(win.cms),
         "hll": arr(win.hll),
         "ent": arr(win.ent),
@@ -215,6 +225,10 @@ def encode_window(win: SealedWindow) -> tuple[dict, bytes]:
         "topk_keys": win.topk_keys,
         "topk_counts": win.topk_counts,
     }
+    if win.inv_count is not None:
+        arrays["inv_count"] = win.inv_count
+        arrays["inv_keysum"] = win.inv_keysum
+        arrays["inv_fpsum"] = win.inv_fpsum
     skeys = win.slice_keys
     if skeys:
         arrays["slice_events"] = np.array(
@@ -292,6 +306,9 @@ def decode_window(header: dict, payload: bytes) -> SealedWindow:
         digest=header.get("digest", ""),
         level=int(header.get("level", 0)),
         compacted_from=list(header.get("compacted_from") or []),
+        inv_count=arrays.get("inv_count"),
+        inv_keysum=arrays.get("inv_keysum"),
+        inv_fpsum=arrays.get("inv_fpsum"),
     )
 
 
@@ -340,6 +357,33 @@ class MergedWindows:
     slices: dict[str, dict]
     names: dict[int, str]
     skipped: list[str]               # windows dropped from the merge (why)
+    # invertible plane fold (elementwise add); None when any folded
+    # window lacked the plane or disagreed on geometry — the answer then
+    # says so (skipped note) instead of decoding partial coverage
+    inv_count: np.ndarray | None = None
+    inv_keysum: np.ndarray | None = None
+    inv_fpsum: np.ndarray | None = None
+
+    def heavy_flows(self, top: int = 0,
+                    min_count: int = 1) -> list[tuple[int, int]]:
+        """Decode the merged invertible plane → exact (key32, count)
+        pairs for the merged range, recovered from state alone (no
+        candidate ring). Empty when the plane is absent/incomplete."""
+        if self.inv_count is None:
+            return []
+        from ..ops.invertible import inv_decode
+        dec = inv_decode((self.inv_count, self.inv_keysum,
+                          self.inv_fpsum), min_count=min_count)
+        return dec.keys[:top] if top else dec.keys
+
+    def heavy_flow_decode(self):
+        """Full decode result (keys + completeness accounting), or None
+        when the plane is absent."""
+        if self.inv_count is None:
+            return None
+        from ..ops.invertible import inv_decode
+        return inv_decode((self.inv_count, self.inv_keysum,
+                           self.inv_fpsum))
 
     def distinct(self) -> float:
         if self.hll is None:
@@ -378,6 +422,7 @@ def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
     out = MergedWindows(windows=0, nodes=[], start_ts=0.0, end_ts=0.0,
                         events=0, drops=0, cms=None, hll=None, ent=None,
                         candidates={}, slices={}, names={}, skipped=[])
+    inv_dropped = False
     for win in windows:
         if out.cms is not None and (
                 win.cms.shape != out.cms.shape
@@ -393,12 +438,50 @@ def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
             out.hll = win.hll.copy()
             out.ent = win.ent.astype(np.float64).copy()
             out.start_ts, out.end_ts = win.start_ts, win.end_ts
+            if win.inv_count is not None:
+                out.inv_count = win.inv_count.astype(np.int64).copy()
+                out.inv_keysum = win.inv_keysum.astype(np.uint32).copy()
+                out.inv_fpsum = win.inv_fpsum.astype(np.uint32).copy()
         else:
             out.cms += win.cms.astype(np.int64)
             np.maximum(out.hll, win.hll, out=out.hll)
             out.ent += win.ent.astype(np.float64)
             out.start_ts = min(out.start_ts, win.start_ts)
             out.end_ts = max(out.end_ts, win.end_ts)
+        # invertible plane: fold while EVERY window carries a matching
+        # geometry; one window without it (or shaped differently) makes
+        # decode-of-the-range meaningless, so the plane is dropped from
+        # the answer WITH a note — partial coverage must not decode as
+        # if it were total
+        if out.windows > 0:
+            if win.inv_count is None:
+                if out.inv_count is not None and not inv_dropped:
+                    inv_dropped = True
+                    out.skipped.append(
+                        f"{win.node}/{win.gadget} window {win.window}: no "
+                        "invertible plane — heavy-flow decode disabled "
+                        "for this range (partial coverage would lie)")
+                out.inv_count = out.inv_keysum = out.inv_fpsum = None
+            elif out.inv_count is not None:
+                if win.inv_count.shape != out.inv_count.shape:
+                    inv_dropped = True
+                    out.skipped.append(
+                        f"{win.node}/{win.gadget} window {win.window}: "
+                        f"invertible geometry {win.inv_count.shape} "
+                        "differs from the merge base — heavy-flow decode "
+                        "disabled for this range")
+                    out.inv_count = out.inv_keysum = out.inv_fpsum = None
+                else:
+                    out.inv_count += win.inv_count.astype(np.int64)
+                    out.inv_keysum += win.inv_keysum.astype(np.uint32)
+                    out.inv_fpsum += win.inv_fpsum.astype(np.uint32)
+            elif not inv_dropped and win.inv_count is not None:
+                inv_dropped = True
+                out.skipped.append(
+                    f"{win.node}/{win.gadget} window {win.window}: "
+                    "invertible plane present but an earlier window "
+                    "lacked it — heavy-flow decode disabled for this "
+                    "range")
         out.windows += 1
         if win.node and win.node not in out.nodes:
             out.nodes.append(win.node)
@@ -477,6 +560,19 @@ def merged_to_sealed(merged: MergedWindows, *, gadget: str, node: str,
         names=dict(merged.names),
         level=int(level),
         compacted_from=list(compacted_from or []),
+        # the count lane stays int64 on the compaction/pushdown write
+        # path: a super-window can cover an unbounded range, and an
+        # int32 downcast past 2^31 would wrap consistently with the
+        # mod-2^32 key-sum/fingerprint lanes — decoding to a plausible
+        # but WRONG "exact" count. int64 counts decode exactly (only
+        # the sum lanes are modular); merge_windows already folds mixed
+        # int32 (operator-sealed deltas) and int64 windows in int64.
+        inv_count=(merged.inv_count if merged.inv_count is not None
+                   else None),
+        inv_keysum=(merged.inv_keysum if merged.inv_keysum is not None
+                    else None),
+        inv_fpsum=(merged.inv_fpsum if merged.inv_fpsum is not None
+                   else None),
     )
     win.digest = window_digest(win)
     return win

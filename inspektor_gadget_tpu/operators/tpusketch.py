@@ -35,6 +35,10 @@ from ..gadgets.interface import GadgetDesc
 from ..models.autoencoder import AEConfig, ae_init, ae_score, ae_train_step, normalize_counts
 from ..ops import bundle_init, fold64_to_32
 from ..ops.hll import hll_init, hll_update
+from ..ops.invertible import (InvSketch, class_weights, inv_capacity,
+                              inv_decode, inv_init, inv_update,
+                              parse_priority_classes,
+                              validate_class_budget)
 from ..ops.sketches import (bundle_digest_jit, bundle_ingest_jit,
                             bundle_stack_sharded, decode_digest,
                             make_bundle_harvest_sharded,
@@ -79,6 +83,12 @@ def _local_device_count() -> int:
     import jax
     return jax.local_device_count()
 
+
+def _validate_priority_classes(value: str) -> None:
+    """Grammar-level check at the params layer (budget needs inv-rows /
+    inv-log2-buckets and runs at instantiation)."""
+    parse_priority_classes(value)
+
 # device-plane telemetry (batch-grain; the histograms time dispatch-side —
 # device completion is async and surfaces in the next blocking read)
 _tm_events = counter("ig_tpusketch_events_total",
@@ -103,6 +113,11 @@ _tm_ckpt_ok = counter("ig_tpusketch_checkpoints_total",
                       "successful sketch-state checkpoints")
 _tm_ckpt_fail = counter("ig_tpusketch_checkpoint_failures_total",
                         "failed sketch-state checkpoint attempts")
+_tm_cand_overflow = counter(
+    "ig_sketch_candidate_overflow_total",
+    "runs whose top-k candidate population exceeded k (the harvest's "
+    "heavy-hitter re-rank became approximate; summaries carry approx=True)",
+    ("gadget",))
 
 _ckpt_log = get_logger("ig-tpu.tpusketch")
 
@@ -134,8 +149,17 @@ def _hll_ingest_step(h, keys, mask):
     return out, out.registers[:1] + 0
 
 
+def _inv_class_ingest_step(s, keys, weights):
+    """One priority class absorbing its share of a staged batch (weights
+    zeroed outside the class's tenants). Second output is the fence
+    token (fresh, never donated downstream) — the PR-7 contract."""
+    out = inv_update(s, keys, weights)
+    return out, out.count[0, :1] + 0
+
+
 _wcms_ingest_jit = jax.jit(_wcms_ingest_step, donate_argnums=0)
 _hll_ingest_jit = jax.jit(_hll_ingest_step, donate_argnums=0)
+_inv_class_jit = jax.jit(_inv_class_ingest_step, donate_argnums=0)
 
 
 @dataclasses.dataclass
@@ -157,6 +181,19 @@ class SketchSummary:
     anomaly: dict[int, float] | None = None  # mntns-slot → score
     epoch: int = 0
     names: dict[int, str] = dataclasses.field(default_factory=dict)  # key32 → label
+    # candidate-ring accounting (ISSUE 15): True once the tracked top-k
+    # population exceeded k — heavy_hitters is then the documented
+    # approximation, not the exact re-rank
+    approx: bool = False
+    # invertible-plane decode of the (merged) sketch state: EXACT
+    # (key32, count) pairs recovered with zero per-key storage, and the
+    # subset of them the candidate ring MISSED (the observable win)
+    decoded: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+    decoded_only: list[tuple[int, int]] = dataclasses.field(
+        default_factory=list)
+    inv: dict | None = None        # decode accounting {recovered, complete,
+    #                                residual_events, capacity}
+    classes: dict[str, dict] | None = None  # priority class → decode answer
     # flat numeric access for detector rules lives in ONE place:
     # alerts.rules.summary_fields (handles this dataclass and the
     # wire-decoded dict shape alike)
@@ -252,6 +289,35 @@ class TpuSketch(Operator):
                       description="H2D double-buffer depth: transfers of "
                                   "batch k+1..k+N-1 overlap device compute "
                                   "of batch k"),
+            # invertible heavy-key plane (ISSUE 15): recover WHICH keys
+            # from merged sketch state alone — rides the fused kernel as
+            # extra grid planes, merges via the existing psum algebra
+            ParamDesc(key="invertible", default="false",
+                      type_hint=TypeHint.BOOL,
+                      description="add the invertible heavy-key plane: "
+                                  "decode of (merged) state recovers "
+                                  "exact (key, count) pairs with zero "
+                                  "per-key storage"),
+            ParamDesc(key="inv-log2-buckets", default="12",
+                      type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=6, hi=20),
+                      description="buckets per invertible row (decode "
+                                  "capacity ~ rows*buckets/4 distinct "
+                                  "keys)"),
+            ParamDesc(key="inv-rows", default="3", type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=2, hi=8),
+                      description="invertible hash rows (peeling "
+                                  "redundancy; 3 is the sweet spot)"),
+            ParamDesc(key="priority-classes", default="",
+                      validator=_validate_priority_classes,
+                      description="PSketch-style accuracy classes: "
+                                  "name=log2buckets:mntns|mntns,... with "
+                                  "one '*' catch-all (e.g. "
+                                  "hot=12:101|102,rest=10:*); classes "
+                                  "partition the base invertible memory "
+                                  "budget so hot tenants keep decode "
+                                  "fidelity when the whole stream "
+                                  "overflows it"),
             # multi-chip sharded ingest (ISSUE 14): one fused bundle
             # replica per chip, batches round-robined onto per-device
             # lanes, psum/pmax collective merge at harvest only
@@ -356,12 +422,42 @@ class TpuSketchInstance(OperatorInstance):
         self._m_h2d = _tm_h2d.labels(gadget=g)
         self._m_update = _tm_update.labels(gadget=g)
         self._m_harvest_s = _tm_harvest_s.labels(gadget=g)
+        # -- invertible heavy-key plane + priority classes (ISSUE 15) ----
+        # All validation answers a typed ParamError HERE, before the
+        # first batch: classes without the plane, and class geometries
+        # overrunning the base memory budget, are config errors.
+        self._inv_on = (p.get("invertible").as_bool()
+                        if "invertible" in p else False)
+        self._inv_rows = (p.get("inv-rows").as_int()
+                          if "inv-rows" in p else 3)
+        self._inv_lb = (p.get("inv-log2-buckets").as_int()
+                        if "inv-log2-buckets" in p else 12)
+        classes_spec = (p.get("priority-classes").as_string()
+                        if "priority-classes" in p else "")
+        self._inv_classes: list[tuple[Any, InvSketch]] = []
+        if classes_spec:
+            if not self._inv_on:
+                raise ParamError(
+                    "param 'priority-classes': needs 'invertible true' — "
+                    "accuracy classes partition the invertible plane's "
+                    "memory budget")
+            try:
+                cls = parse_priority_classes(classes_spec)
+                validate_class_budget(cls, rows=self._inv_rows,
+                                      log2_buckets=self._inv_lb)
+            except ValueError as e:
+                raise ParamError(f"param 'priority-classes': {e}") from None
+            self._inv_classes = [
+                (c, inv_init(self._inv_rows, c.log2_buckets)) for c in cls]
+        self._overflow_counted = False
         self.bundle = bundle_init(
             depth=p.get("depth").as_int(),
             log2_width=p.get("log2-width").as_int(),
             hll_p=p.get("hll-p").as_int(),
             entropy_log2_width=p.get("entropy-log2-width").as_int(),
             k=p.get("topk").as_int(),
+            inv_rows=self._inv_rows if self._inv_on else 0,
+            inv_log2_buckets=self._inv_lb,
         )
         self.anomaly_on = p.get("anomaly").as_bool()
         self.anomaly_model = (p.get("anomaly-model").as_string()
@@ -508,6 +604,7 @@ class TpuSketchInstance(OperatorInstance):
             self._win_events0 = 0.0
             self._win_drops0 = 0.0
             self._win_ent0 = np.asarray(self.bundle.entropy.counts).copy()
+            self._win_inv0 = self._inv_host(self.bundle)
             self._win_slices: dict[str, Any] = {}
             self._win_slices_dropped_keys: set[str] = set()
             from ..history import HISTORY
@@ -554,6 +651,7 @@ class TpuSketchInstance(OperatorInstance):
             self._win_events0 = float(self.bundle.events)
             self._win_drops0 = float(self.bundle.drops)
             self._win_ent0 = np.asarray(self.bundle.entropy.counts).copy()
+            self._win_inv0 = self._inv_host(self.bundle)
         with _live_mu:
             _live[ctx.run_id] = self
 
@@ -563,6 +661,53 @@ class TpuSketchInstance(OperatorInstance):
         cur = TRACER.current_context()
         return TRACER.span(name, parent=cur if cur is not None
                            else self._trace_parent, attrs=attrs)
+
+    # -- invertible plane helpers (ISSUE 15) --------------------------------
+
+    @staticmethod
+    def _inv_host(b) -> tuple | None:
+        """Host snapshot of the bundle's invertible lanes (window-open
+        baseline for seal deltas). Caller must hold _bundle_mu when `b`
+        is the live bundle (the next update donates its buffers)."""
+        if b.inv is None:
+            return None
+        return (np.asarray(b.inv.count).astype(np.int64).copy(),
+                np.asarray(b.inv.keysum).copy(),
+                np.asarray(b.inv.fpsum).copy())
+
+    @staticmethod
+    def _padded_mntns(batch: EventBatch, n: int, pad: int) -> np.ndarray:
+        """The batch's mntns column padded to the staged lane length
+        (pad slots carry 0, which no tenant claims — weight 0 anyway)."""
+        out = np.zeros(pad, dtype=np.uint64)
+        out[:n] = batch.cols["mntns"][:n]
+        return out
+
+    def _inv_class_absorb(self, keys, mntns_np: np.ndarray,
+                          w_np: np.ndarray) -> list:
+        """Per-priority-class invertible updates for one batch. Class
+        sketches stay single-chip like the history window plane, so
+        summed per-class decodes reproduce whole-stream totals at any
+        chip count. `keys` is the already-staged device array on the
+        single-chip path (jnp.asarray is a no-op) and the host lane
+        under sharding (the staged copy lives on another chip); the
+        per-class weight vectors are host-computed tenant masks and pay
+        the only new transfer. Run thread only; returns fence tokens (on
+        CPU PJRT the restaged arrays may alias the pinned block)."""
+        if not self._inv_classes:
+            return []
+        wts = class_weights([c for c, _ in self._inv_classes],
+                            mntns_np, w_np)
+        toks = []
+        keys_d = jnp.asarray(keys)
+        for i, ((c, s), w_c) in enumerate(zip(list(self._inv_classes),
+                                              wts)):
+            if not w_c.any():
+                continue
+            s2, tok = _inv_class_jit(s, keys_d, jnp.asarray(w_c))
+            self._inv_classes[i] = (c, s2)
+            toks.append(tok)
+        return toks
 
     # the columnar hot path -------------------------------------------------
 
@@ -791,6 +936,10 @@ class TpuSketchInstance(OperatorInstance):
                         jnp.asarray(w) > 0)
                     self._accumulate_slices(batch, n, hh, distinct, dist)
                     window_tokens = [wtok, htok]
+                if self._inv_classes:
+                    with self._bundle_mu:
+                        window_tokens += self._inv_class_absorb(
+                            hh, self._padded_mntns(batch, n, len(hh)), w)
                 with self._bundle_mu:
                     self._shard_absorb_locked(
                         hh_d, distinct_d, dist_d, w_d,
@@ -815,6 +964,16 @@ class TpuSketchInstance(OperatorInstance):
                                                           w_d > 0)
                     self._accumulate_slices(batch, n, hh, distinct, dist)
                     fence += [wtok, htok]
+                if self._inv_classes:
+                    # the keys are already staged on the device (hh_d):
+                    # reuse them instead of re-uploading the host lane —
+                    # only per-class WEIGHT vectors need a transfer.
+                    # Under _bundle_mu: _inv_class_jit donates, and the
+                    # checkpointer thread snapshots class state under
+                    # the same lock
+                    with self._bundle_mu:
+                        fence += self._inv_class_absorb(
+                            hh_d, self._padded_mntns(batch, n, len(hh)), w)
                 # every consumer of the staged arrays is in the fence: the
                 # pinned block is reused only once they all completed (on
                 # CPU PJRT the device arrays may alias the host block, so
@@ -887,6 +1046,10 @@ class TpuSketchInstance(OperatorInstance):
                         self._win_hll, jnp.asarray(fb.keys),
                         jnp.asarray(fb.weights) > 0)
                     window_tokens = [wtok, htok]
+                if self._inv_classes:
+                    with self._bundle_mu:
+                        window_tokens += self._inv_class_absorb(
+                            fb.keys, fb.mntns, fb.weights)
                 with self._bundle_mu:
                     self._shard_absorb_locked(
                         k_d, k_d, k_d, w_d, float(max(new_drops, 0)),
@@ -907,6 +1070,12 @@ class TpuSketchInstance(OperatorInstance):
                     self._win_hll, htok = _hll_ingest_jit(self._win_hll, k_d,
                                                           w_d > 0)
                     fence += [wtok, htok]
+                if self._inv_classes:
+                    # staged keys (k_d) reused — see enrich_batch; under
+                    # _bundle_mu for the checkpointer snapshot
+                    with self._bundle_mu:
+                        fence += self._inv_class_absorb(k_d, fb.mntns,
+                                                        fb.weights)
                 stager.fence(tuple(fence))
         t2 = time.perf_counter()
         self._m_h2d.observe(t1 - t0)
@@ -1091,6 +1260,7 @@ class TpuSketchInstance(OperatorInstance):
             drops = float(b.drops)
             ent_now = np.asarray(b.entropy.counts).copy()
             cand = np.asarray(b.topk.keys).copy()
+            inv_now = self._inv_host(b)
         win_events = int(events - self._win_events0)
         if win_events <= 0 and not self._win_slices:
             self._win_start = end
@@ -1105,6 +1275,17 @@ class TpuSketchInstance(OperatorInstance):
                 if cand[i] != 0 and counts[i] > 0]
         self._resolve_late([k for k, _ in keep[:32]])
         self._win_n += 1
+        # invertible plane rides the window as a cumulative-state DELTA:
+        # the lanes are pure adds, so subtraction is exact (uint32 wrap
+        # included) and merged windows decode like merged live state
+        inv_kw = {}
+        if inv_now is not None and self._win_inv0 is not None:
+            inv_kw = {
+                "inv_count": (inv_now[0]
+                              - self._win_inv0[0]).astype(np.int32),
+                "inv_keysum": inv_now[1] - self._win_inv0[1],
+                "inv_fpsum": inv_now[2] - self._win_inv0[2],
+            }
         win = SealedWindow(
             gadget=self._hist_gadget,
             node=self.ctx.extra.get("node", "") or "",
@@ -1124,6 +1305,7 @@ class TpuSketchInstance(OperatorInstance):
                     for key, s in self._win_slices.items()},
             names={k: self._names[k] for k, _ in keep if k in self._names},
             slices_dropped=len(self._win_slices_dropped_keys),
+            **inv_kw,
         )
         win.digest = window_digest(win)
         try:
@@ -1168,6 +1350,7 @@ class TpuSketchInstance(OperatorInstance):
         self._win_events0 = events
         self._win_drops0 = drops
         self._win_ent0 = ent_now
+        self._win_inv0 = inv_now
         self._win_slices = {}
         self._win_slices_dropped_keys = set()
 
@@ -1185,13 +1368,72 @@ class TpuSketchInstance(OperatorInstance):
         # bundle lock so a concurrent update can't donate the buffers
         # mid-read. Under shard-ingest _merged_locked flushes the open
         # round and runs the collective harvest first — same digest, any
-        # chip count.
+        # chip count. The invertible decode's DEVICE loop dispatches
+        # under the same lock (its outputs are fresh buffers, and the
+        # dispatched computation pins its inputs against later donation);
+        # the numpy finisher runs outside it.
+        inv_dev = None
         with self._bundle_mu:
-            digest = bundle_digest_jit(self._merged_locked())
-        events_f, drops_f, distinct, entropy_bits, keys, counts = (
+            merged = self._merged_locked()
+            digest = bundle_digest_jit(merged)
+            if self._inv_on and merged.inv is not None:
+                from ..ops.invertible import inv_decode_device
+                cap = min(4096, inv_capacity(self._inv_rows, self._inv_lb))
+                inv_dev = inv_decode_device(merged.inv, sweeps=2, cap=cap)
+        events_f, drops_f, distinct, entropy_bits, approx, keys, counts = (
             decode_digest(digest))
+        if approx and not self._overflow_counted:
+            # count RUNS that crossed into approximation, not harvests:
+            # the flag is latched, so one inc per instance is the honest
+            # cardinality
+            self._overflow_counted = True
+            _tm_cand_overflow.labels(gadget=self.ctx.desc.full_name).inc()
         order = np.argsort(-counts)
         hh = [(int(keys[i]), int(counts[i])) for i in order if keys[i] != 0]
+        # invertible plane: decode the merged state → exact (key, count)
+        # pairs, plus the keys the candidate ring MISSED (satellite 2's
+        # observable win: e.g. a key heavy only fleet-wide)
+        decoded: list[tuple[int, int]] = []
+        decoded_only: list[tuple[int, int]] = []
+        inv_info = None
+        classes_out = None
+        if inv_dev is not None:
+            from ..ops.invertible import inv_decode_finish
+            dec = inv_decode_finish(*inv_dev)
+            # the FULL recovery rides the in-process summary: the alert
+            # engine builds one heavy_flow state machine per decoded key
+            # and a truncation here would starve keys past the cut (and
+            # flap the boundary key); the wire codec caps what it ships
+            decoded = dec.keys
+            ring = {k for k, _ in hh}
+            decoded_only = [(k, c) for k, c in dec.keys if k not in ring]
+            inv_info = {"recovered": dec.recovered,
+                        "complete": dec.complete,
+                        "residual_events": dec.residual_events,
+                        "capacity": inv_capacity(self._inv_rows,
+                                                 self._inv_lb)}
+            if self._inv_classes:
+                # snapshot under the lock (the next class update donates
+                # these buffers), decode on the host copies outside it
+                with self._bundle_mu:
+                    cls_snap = [
+                        (c, (np.asarray(s.count), np.asarray(s.keysum),
+                             np.asarray(s.fpsum)))
+                        for c, s in self._inv_classes]
+                classes_out = {}
+                for c, arrs in cls_snap:
+                    cdec = inv_decode(arrs)
+                    classes_out[c.name] = {
+                        "tenants": (list(c.tenants)
+                                    if c.tenants is not None else "*"),
+                        "log2_buckets": c.log2_buckets,
+                        "capacity": inv_capacity(self._inv_rows,
+                                                 c.log2_buckets),
+                        "decoded": cdec.top(32),
+                        "recovered": cdec.recovered,
+                        "complete": cdec.complete,
+                        "residual_events": cdec.residual_events,
+                    }
         # late enrichment: names resolve HERE (once per tick, from the
         # sample ring), not in the per-batch ingest path
         self._resolve_late([k for k, _ in hh[:32]])
@@ -1220,6 +1462,11 @@ class TpuSketchInstance(OperatorInstance):
             anomaly=anomaly,
             epoch=self._epoch,
             names={k: self._names[k] for k, _ in hh if k in self._names},
+            approx=approx,
+            decoded=decoded,
+            decoded_only=decoded_only,
+            inv=inv_info,
+            classes=classes_out,
         )
         # read the consumer LIVE from ctx.extra (falling back to the one
         # captured at init): the alerts operator chains its engine into
@@ -1303,8 +1550,16 @@ class TpuSketchInstance(OperatorInstance):
                 with _tm_merge_s.time():
                     self.bundle = bundle_merge(self.bundle, prior)
         except Exception as e:  # noqa: BLE001
-            _ckpt_log.debug("resume of %s skipped (fresh state): %r",
-                            self._ckpt_key, e)
+            # a checkpoint that EXISTS but fails to load (torn zip,
+            # config change, a bundle-treedef change across an upgrade —
+            # e.g. the ISSUE-15 overflow/inv fields) resets accumulated
+            # state: that must be visible, not a debug whisper; a simply
+            # absent file stays quiet
+            log_fn = (_ckpt_log.warning
+                      if base.with_suffix(".npz").exists()
+                      else _ckpt_log.debug)
+            log_fn("resume of %s skipped (fresh state): %r",
+                   self._ckpt_key, e)
         if self.scorer is not None:
             try:
                 self.scorer = load_pytree(
@@ -1312,6 +1567,26 @@ class TpuSketchInstance(OperatorInstance):
             except Exception as e:  # noqa: BLE001
                 _ckpt_log.debug("scorer resume of %s skipped: %r",
                                 self._ckpt_key, e)
+        if self._inv_classes:
+            # priority-class state resumes like the bundle: merge the
+            # prior class sketches position-wise (a class-config change
+            # shows up as a treedef/geometry mismatch and falls back to
+            # fresh, loudly when the file exists), so per-class decodes
+            # keep reproducing whole-stream totals across a restart
+            from ..ops.invertible import inv_merge
+            cls_base = Path(str(base) + "-invclasses")
+            try:
+                prior = load_pytree(
+                    cls_base, like=tuple(s for _, s in self._inv_classes))
+                self._inv_classes = [
+                    (c, inv_merge(s, p))
+                    for (c, s), p in zip(self._inv_classes, prior)]
+            except Exception as e:  # noqa: BLE001
+                log_fn = (_ckpt_log.warning
+                          if cls_base.with_suffix(".npz").exists()
+                          else _ckpt_log.debug)
+                log_fn("class resume of %s skipped (fresh class state): "
+                       "%r", self._ckpt_key, e)
 
     def checkpoint(self) -> None:
         """Host-offload + save current state. Two concurrent runs of the
@@ -1335,9 +1610,14 @@ class TpuSketchInstance(OperatorInstance):
                 bundle_host = jax.tree.map(np.asarray, self._merged_locked())
                 scorer_host = (jax.tree.map(np.asarray, self.scorer)
                                if self.scorer is not None else None)
+                classes_host = (tuple(jax.tree.map(np.asarray, s)
+                                      for _, s in self._inv_classes)
+                                if self._inv_classes else None)
             save_pytree(base, bundle_host)
             if scorer_host is not None:
                 save_pytree(Path(str(base) + "-scorer"), scorer_host)
+            if classes_host is not None:
+                save_pytree(Path(str(base) + "-invclasses"), classes_host)
 
     # display helpers -------------------------------------------------------
 

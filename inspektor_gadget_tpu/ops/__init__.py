@@ -17,6 +17,10 @@ from .countmin import CountMin, cms_init, cms_update, cms_query, cms_merge
 from .hll import HLL, hll_init, hll_update, hll_estimate, hll_merge
 from .entropy import EntropySketch, entropy_init, entropy_update, entropy_estimate, entropy_merge
 from .topk import TopK, topk_init, topk_update, topk_merge, topk_values
+from .invertible import (
+    InvSketch, InvDecode, inv_init, inv_update, inv_merge, inv_psum,
+    inv_decode, inv_capacity,
+)
 from .quantiles import (
     DDSketch, dd_init, dd_update, dd_quantile, dd_merge, dd_psum,
     dd_histogram_log2,
@@ -32,6 +36,8 @@ __all__ = [
     "HLL", "hll_init", "hll_update", "hll_estimate", "hll_merge",
     "EntropySketch", "entropy_init", "entropy_update", "entropy_estimate", "entropy_merge",
     "TopK", "topk_init", "topk_update", "topk_merge", "topk_values",
+    "InvSketch", "InvDecode", "inv_init", "inv_update", "inv_merge",
+    "inv_psum", "inv_decode", "inv_capacity",
     "DDSketch", "dd_init", "dd_update", "dd_quantile", "dd_merge",
     "dd_psum", "dd_histogram_log2",
     "SketchBundle", "bundle_init", "bundle_update", "bundle_update_fused",

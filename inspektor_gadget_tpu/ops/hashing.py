@@ -46,6 +46,19 @@ def fmix32(h: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+def fmix32_np(h: np.ndarray) -> np.ndarray:
+    """Host-side numpy twin of fmix32, kept bit-identical so host decode
+    paths (merged-window heavy-flow recovery, slice sketches) agree with
+    device-built state from any node."""
+    h = np.asarray(h, dtype=np.uint32).copy()
+    h ^= h >> np.uint32(16)
+    h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h ^= h >> np.uint32(13)
+    h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    return h
+
+
 def multiply_shift(keys: jnp.ndarray, row: int, log2_width: int) -> jnp.ndarray:
     """Row `row`'s bucket index in [0, 2**log2_width): multiply-shift over
     uint32 with a finalizer, keeping the top bits (the well-mixed ones)."""

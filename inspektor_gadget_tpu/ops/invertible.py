@@ -1,0 +1,572 @@
+"""Invertible heavy-key sketch: recover *which* keys from merged state.
+
+"A Fast and Compact Invertible Sketch for Network-Wide Heavy Flow
+Detection" (arxiv 1910.10441) motivates the shape: heavy-hitter output
+must not depend on per-key candidate storage, because a key that is
+heavy only *network-wide* (after the cluster merge) was never tracked
+by any single node. This module implements the pure-additive variant of
+that idea so the distributed story stays trivial:
+
+- per (row, bucket) three integer lanes: ``count`` (sum of weights),
+  ``keysum`` (sum of key*weight mod 2^32) and ``fpsum`` (sum of
+  fingerprint(key)*weight mod 2^32);
+- update is pure integer adds → merge is elementwise add, and
+  cluster/fleet aggregation is exactly the existing algebra
+  (``jax.lax.psum`` on device, numpy add over sealed windows);
+- decode runs on MERGED state: iterative pure-bucket peeling. A bucket
+  holding exactly one distinct key satisfies ``keysum == key*count``
+  and ``fpsum == fp(key)*count`` (mod 2^32) and the candidate re-hashes
+  into its own bucket; peeling subtracts each verified key from every
+  row and repeats, draining mixed buckets down to pure ones. The sweep
+  is a jittable fixed-iteration device loop (odd counts invert via the
+  Newton modular inverse); the host finisher peels the remainder,
+  including even-count buckets via bounded trailing-zero enumeration.
+
+Decode contract (the documented envelope tests pin):
+
+- every recovered (key, count) pair is EXACT — counts come from pure
+  buckets, and merging adds no error (the lanes are homomorphic);
+- recovery is COMPLETE whenever the distinct-key load fits the peeling
+  capacity ``inv_capacity()`` — conservatively rows*buckets/4, far
+  inside the random-hypergraph 2-core threshold — with one documented
+  blind spot: a key whose TOTAL weight is divisible by 2^17 or more
+  (the mod-2^32 key-sum then retains too few key bits to enumerate;
+  ~2^-17 per heavy key on natural count distributions);
+- beyond capacity recovery degrades to PARTIAL (the densest buckets
+  never become pure) and ``InvDecode.complete`` is False — consumers
+  surface that instead of trusting coverage. PSketch-style priority
+  classes (arxiv 2509.07338) exist exactly for this: give hot tenants
+  their own geometry so *their* load stays under capacity when the
+  fleet-wide stream does not.
+
+Key 0 is the reserved empty/pad value everywhere in the sketch plane
+and is not recoverable (its contribution is weight-0 by convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashing import _row_multiplier, fmix32, fmix32_np
+
+# hash rows disjoint from the CMS rows (0..depth-1) so the invertible
+# plane's bucket choices are independent of the count-min plane built
+# over the same keys; fixed so state built anywhere merges coherently
+INV_ROW_OFFSET = 16
+# fingerprint family: fmix32 over a salted key — one multiply-free xor
+# keeps the kernel cheap while staying independent of the bucket hash
+FP_SALT = 0x7F4A7C15
+# host finisher enumerates 2^t candidates (one vectorized numpy check)
+# for a pure bucket whose count has t trailing zero bits; a count
+# divisible by 2^17 or more is the one documented blind spot of the
+# mod-2^32 key-sum (the sum retains only 32-t bits of the key) — at
+# ~2^-17 per heavy key on natural count distributions it is noise, and
+# such a bucket stays in the residual (reported, never guessed)
+_MAX_EVEN_T = 16
+
+
+@flax.struct.dataclass
+class InvSketch:
+    count: jnp.ndarray   # (rows, buckets) int32 — sum of weights
+    keysum: jnp.ndarray  # (rows, buckets) uint32 — sum key*w mod 2^32
+    fpsum: jnp.ndarray   # (rows, buckets) uint32 — sum fp(key)*w mod 2^32
+    log2_buckets: int = flax.struct.field(pytree_node=False)
+
+    @property
+    def rows(self) -> int:
+        return self.count.shape[0]
+
+    @property
+    def buckets(self) -> int:
+        return self.count.shape[1]
+
+
+def inv_init(rows: int = 3, log2_buckets: int = 12) -> InvSketch:
+    w = 1 << log2_buckets
+    return InvSketch(
+        count=jnp.zeros((rows, w), jnp.int32),
+        keysum=jnp.zeros((rows, w), jnp.uint32),
+        fpsum=jnp.zeros((rows, w), jnp.uint32),
+        log2_buckets=log2_buckets,
+    )
+
+
+def inv_capacity(rows: int, log2_buckets: int) -> int:
+    """Documented decode capacity: distinct keys up to rows*buckets/4
+    peel completely with overwhelming probability (load 0.25 per cell —
+    conservatively inside the random-hypergraph 2-core threshold for
+    every rows >= 2)."""
+    return (rows << log2_buckets) // 4
+
+
+def inv_bytes(rows: int, log2_buckets: int) -> int:
+    """State bytes of one geometry (3 int32 lanes per bucket) — the unit
+    the priority-class budget is validated in."""
+    return 3 * 4 * (rows << log2_buckets)
+
+
+def inv_fingerprint(keys: jnp.ndarray) -> jnp.ndarray:
+    return fmix32(keys.astype(jnp.uint32) ^ jnp.uint32(FP_SALT))
+
+
+def inv_bucket(keys: jnp.ndarray, row: int, log2_buckets: int) -> jnp.ndarray:
+    """Row `row`'s bucket index — the multiply-shift family at a row id
+    offset past the CMS rows (same seed table, disjoint rows)."""
+    r = INV_ROW_OFFSET + row
+    salt = jnp.uint32((r * 0x9E3779B9) & 0xFFFFFFFF)
+    h = fmix32(keys.astype(jnp.uint32) * _row_multiplier(r) + salt)
+    return (h >> (32 - log2_buckets)).astype(jnp.int32)
+
+
+def _fp_np(keys: np.ndarray) -> np.ndarray:
+    return fmix32_np(np.asarray(keys, np.uint32) ^ np.uint32(FP_SALT))
+
+
+def _bucket_np(keys: np.ndarray, row: int, log2_buckets: int) -> np.ndarray:
+    r = INV_ROW_OFFSET + row
+    salt = np.uint32((r * 0x9E3779B9) & 0xFFFFFFFF)
+    h = fmix32_np(np.asarray(keys, np.uint32)
+                  * np.uint32(_row_multiplier(r)) + salt)
+    return (h >> np.uint32(32 - log2_buckets)).astype(np.int64)
+
+
+def inv_update(state: InvSketch, keys: jnp.ndarray,
+               weights: jnp.ndarray | None = None) -> InvSketch:
+    """Absorb a batch: pure integer scatter-adds on all three lanes.
+    `weights` follows the bundle weights-lane contract (pad slots weigh
+    0, pre-aggregated slots may weigh > 1); uint32 lanes wrap mod 2^32
+    by construction — that IS the algebra decode inverts."""
+    k = keys.astype(jnp.uint32)
+    if weights is None:
+        w = jnp.ones(keys.shape, jnp.int32)
+    else:
+        w = weights.astype(jnp.int32)
+    wu = w.astype(jnp.uint32)
+    fp = inv_fingerprint(k)
+    count, keysum, fpsum = state.count, state.keysum, state.fpsum
+    for r in range(state.rows):
+        idx = inv_bucket(k, r, state.log2_buckets)
+        count = count.at[r, idx].add(w)
+        keysum = keysum.at[r, idx].add(k * wu)
+        fpsum = fpsum.at[r, idx].add(fp * wu)
+    return state.replace(count=count, keysum=keysum, fpsum=fpsum)
+
+
+def inv_merge(a: InvSketch, b: InvSketch) -> InvSketch:
+    return a.replace(count=a.count + b.count, keysum=a.keysum + b.keysum,
+                     fpsum=a.fpsum + b.fpsum)
+
+
+def inv_psum(state: InvSketch, axis_name: str) -> InvSketch:
+    """Cluster-wide merge: one all-reduce per lane — the same psum the
+    CMS/entropy planes ride (integer adds wrap identically)."""
+    return state.replace(
+        count=jax.lax.psum(state.count, axis_name),
+        keysum=jax.lax.psum(state.keysum, axis_name),
+        fpsum=jax.lax.psum(state.fpsum, axis_name),
+    )
+
+
+def modinv32_odd(c: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of an odd uint32 mod 2^32 via Newton iteration (x0 = c is
+    correct mod 8; each step doubles the valid bits — 4 steps reach 48).
+    Garbage for even inputs; callers mask on oddness."""
+    c = c.astype(jnp.uint32)
+    x = c
+    for _ in range(4):
+        x = x * (jnp.uint32(2) - c * x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Decode: jittable device sweeps + numpy host finisher
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("sweeps", "cap"),
+                   donate_argnums=())
+def inv_decode_device(state: InvSketch, *, sweeps: int = 4,
+                      cap: int = 1024):
+    """Fixed-iteration pure-bucket peeling on device → (residual state,
+    keys (cap,) uint32, counts (cap,) int32, n_recovered). Each sweep
+    scans every row for verified pure buckets with ODD counts (the
+    modular inverse exists), subtracts the recovered keys from all rows,
+    and appends them to a bounded buffer; pure buckets that don't fit
+    the buffer are left IN the sketch for the host finisher, so nothing
+    is ever silently dropped. Never donates: harvest decodes the live
+    merged state."""
+    rows = state.rows
+    w = state.buckets
+    arange_w = jnp.arange(w, dtype=jnp.int32)
+    keys_buf0 = jnp.zeros(cap + 1, jnp.uint32)
+    cnt_buf0 = jnp.zeros(cap + 1, jnp.int32)
+
+    def sweep(_, carry):
+        count, keysum, fpsum, keys_buf, cnt_buf, cursor = carry
+        for r in range(rows):
+            cnt = count[r]
+            cnt_u = cnt.astype(jnp.uint32)
+            odd = (cnt > 0) & ((cnt & 1) == 1)
+            cand = keysum[r] * modinv32_odd(cnt_u)
+            fp = inv_fingerprint(cand)
+            pure = (odd & (cand != 0)
+                    & (fpsum[r] == fp * cnt_u)
+                    & (inv_bucket(cand, r, state.log2_buckets) == arange_w))
+            pos = cursor + jnp.cumsum(pure.astype(jnp.int32)) - 1
+            fits = pure & (pos < cap)
+            slot = jnp.where(fits, pos, cap)
+            c_rec = jnp.where(fits, cnt, 0)
+            keys_buf = keys_buf.at[slot].set(jnp.where(fits, cand,
+                                                       jnp.uint32(0)))
+            cnt_buf = cnt_buf.at[slot].set(c_rec)
+            cursor = cursor + fits.sum(dtype=jnp.int32)
+            c_u = c_rec.astype(jnp.uint32)
+            for r2 in range(rows):
+                idx2 = inv_bucket(cand, r2, state.log2_buckets)
+                count = count.at[r2, idx2].add(-c_rec)
+                keysum = keysum.at[r2, idx2].add(
+                    jnp.zeros_like(c_u) - cand * c_u)
+                fpsum = fpsum.at[r2, idx2].add(
+                    jnp.zeros_like(c_u) - fp * c_u)
+        return count, keysum, fpsum, keys_buf, cnt_buf, cursor
+
+    count, keysum, fpsum, keys_buf, cnt_buf, n = jax.lax.fori_loop(
+        0, sweeps, sweep,
+        (state.count, state.keysum, state.fpsum, keys_buf0, cnt_buf0,
+         jnp.zeros((), jnp.int32)))
+    residual = state.replace(count=count, keysum=keysum, fpsum=fpsum)
+    return residual, keys_buf[:cap], cnt_buf[:cap], n
+
+
+@dataclasses.dataclass
+class InvDecode:
+    """One decode result: recovered keys are EXACT (key32, total weight)
+    pairs; `complete` says whether the whole sketch drained (all lanes
+    back to zero) — False means the distinct-key load exceeded the
+    peeling capacity and coverage is partial, not wrong."""
+
+    keys: list[tuple[int, int]]
+    recovered: int
+    residual_events: int      # weight left undecoded (row-0 count sum)
+    complete: bool
+    sweeps: int
+
+    def top(self, k: int) -> list[tuple[int, int]]:
+        return self.keys[:k]
+
+
+def _host_peel(count: np.ndarray, keysum: np.ndarray, fpsum: np.ndarray,
+               log2_buckets: int, recovered: dict[int, int],
+               max_sweeps: int) -> int:
+    """Numpy peeling to fixpoint, including even-count buckets: an even
+    count 2^t*odd determines the key's low (32-t) bits; the remaining t
+    bits enumerate (bounded by _MAX_EVEN_T) and the fingerprint + row
+    membership verify. Returns sweeps used."""
+    rows, w = count.shape
+    arange_w = np.arange(w, dtype=np.int64)
+    sweeps = 0
+    for _ in range(max_sweeps):
+        sweeps += 1
+        progress = False
+        for r in range(rows):
+            cnt = count[r]
+            live = cnt > 0
+            if not live.any():
+                continue
+            keys_r: list[np.ndarray] = []
+            cnts_r: list[np.ndarray] = []
+            cnt_u = cnt.astype(np.uint32)
+            # odd counts: direct modular inversion
+            odd = live & ((cnt & 1) == 1)
+            if odd.any():
+                inv = _modinv32_np(cnt_u)
+                cand = (keysum[r] * inv).astype(np.uint32)
+                ok = (odd & (cand != 0)
+                      & (fpsum[r] == _fp_np(cand) * cnt_u)
+                      & (_bucket_np(cand, r, log2_buckets) == arange_w))
+                if ok.any():
+                    keys_r.append(cand[ok])
+                    cnts_r.append(cnt[ok].astype(np.int64))
+            # even counts: strip 2^t, invert the odd part, enumerate the
+            # t unknown high bits, verify each candidate
+            even = live & ((cnt & 1) == 0)
+            if even.any():
+                idxs = np.flatnonzero(even)
+                c = cnt[idxs].astype(np.int64)
+                t = np.zeros(len(idxs), np.int64)
+                cc = c.copy()
+                while ((cc & 1) == 0).any():
+                    sel = (cc & 1) == 0
+                    cc[sel] >>= 1
+                    t[sel] += 1
+                keep = t <= _MAX_EVEN_T
+                idxs, c, t, cc = idxs[keep], c[keep], t[keep], cc[keep]
+                if idxs.size:
+                    inv_odd = _modinv32_np(cc.astype(np.uint32))
+                    base = (keysum[r][idxs] * inv_odd).astype(np.uint32)
+                    # base = key << t (mod 2^32): low t bits must be zero
+                    low_ok = (base & ((np.uint32(1) << t.astype(np.uint32))
+                                      - np.uint32(1))) == 0
+                    for j_idx in np.flatnonzero(low_ok):
+                        b_i = int(idxs[j_idx])
+                        tt = int(t[j_idx])
+                        cn = int(c[j_idx])
+                        low = int(base[j_idx]) >> tt
+                        # one vectorized check over all 2^t candidates:
+                        # the key's unknown top t bits enumerate, bucket
+                        # membership + fingerprint verify, and only a
+                        # UNIQUE survivor is accepted (2+ survivors —
+                        # probability ~2^(t-32-log2b) — stay undecoded
+                        # rather than guessed)
+                        cands = ((np.arange(1 << tt, dtype=np.uint64)
+                                  << np.uint64(32 - tt))
+                                 | np.uint64(low)).astype(np.uint32)
+                        ok = cands != 0
+                        ok &= _bucket_np(cands, r, log2_buckets) == b_i
+                        ok &= (_fp_np(cands)
+                               * np.uint32(cn & 0xFFFFFFFF)
+                               ).astype(np.uint32) == fpsum[r][b_i]
+                        hits = np.flatnonzero(ok)
+                        if hits.size == 1:
+                            keys_r.append(cands[hits])
+                            cnts_r.append(np.asarray([cn], np.int64))
+            if not keys_r:
+                continue
+            progress = True
+            kk = np.concatenate(keys_r)
+            cc = np.concatenate(cnts_r)
+            cu = cc.astype(np.uint32)
+            for r2 in range(rows):
+                idx2 = _bucket_np(kk, r2, log2_buckets)
+                np.subtract.at(count[r2], idx2, cc.astype(count.dtype))
+                np.subtract.at(keysum[r2], idx2,
+                               (kk * cu).astype(np.uint32))
+                np.subtract.at(fpsum[r2], idx2,
+                               (_fp_np(kk) * cu).astype(np.uint32))
+            for k, c_ in zip(kk.tolist(), cc.tolist()):
+                recovered[int(k)] = recovered.get(int(k), 0) + int(c_)
+        if not progress:
+            break
+    return sweeps
+
+
+def _modinv32_np(c: np.ndarray) -> np.ndarray:
+    c = np.asarray(c, np.uint32)
+    x = c.copy()
+    for _ in range(4):
+        x = (x * ((np.uint32(2) - c * x).astype(np.uint32))).astype(
+            np.uint32)
+    return x
+
+
+def _finish(count: np.ndarray, keysum: np.ndarray, fpsum: np.ndarray,
+            log2_buckets: int, recovered: dict[int, int],
+            host_sweeps: int, min_count: int) -> InvDecode:
+    sweeps = _host_peel(count, keysum, fpsum, log2_buckets, recovered,
+                        host_sweeps)
+    keys = sorted(((k, c) for k, c in recovered.items()
+                   if c >= min_count), key=lambda kv: (-kv[1], kv[0]))
+    residual_events = int(np.maximum(count[0], 0).sum())
+    complete = bool((count == 0).all() and (keysum == 0).all()
+                    and (fpsum == 0).all())
+    return InvDecode(keys=keys, recovered=len(keys),
+                     residual_events=residual_events, complete=complete,
+                     sweeps=sweeps)
+
+
+def inv_decode_finish(residual: InvSketch, keys_buf, cnt_buf, n, *,
+                      host_sweeps: int = 32,
+                      min_count: int = 1) -> InvDecode:
+    """Host finisher over an inv_decode_device result: materialize the
+    device loop's buffer + residual, then numpy-peel to fixpoint (even
+    counts included). Split out so a harvest can DISPATCH the device
+    loop under its state lock (the outputs are fresh buffers) and do the
+    host work outside it."""
+    recovered: dict[int, int] = {}
+    n = int(n)
+    for k, c in zip(np.asarray(keys_buf)[:n].tolist(),
+                    np.asarray(cnt_buf)[:n].tolist()):
+        if k:
+            recovered[int(k)] = recovered.get(int(k), 0) + int(c)
+    count = np.asarray(residual.count).astype(np.int64).copy()
+    keysum = np.asarray(residual.keysum).astype(np.uint32).copy()
+    fpsum = np.asarray(residual.fpsum).astype(np.uint32).copy()
+    return _finish(count, keysum, fpsum, residual.log2_buckets, recovered,
+                   host_sweeps, min_count)
+
+
+def inv_decode(state, *, device_sweeps: int = 4, host_sweeps: int = 32,
+               cap: int = 1024, min_count: int = 1) -> InvDecode:
+    """Full decode of one (merged) invertible sketch: the jittable
+    device loop peels the easy mass first when the state lives on
+    device, then the numpy finisher peels to fixpoint (even counts
+    included). Accepts an InvSketch with jnp OR numpy leaves, or a
+    (count, keysum, fpsum) tuple of numpy arrays."""
+    if isinstance(state, InvSketch):
+        log2_buckets = state.log2_buckets
+        if isinstance(state.count, jnp.ndarray) and not isinstance(
+                state.count, np.ndarray):
+            dev = inv_decode_device(state, sweeps=device_sweeps, cap=cap)
+            return inv_decode_finish(*dev, host_sweeps=host_sweeps,
+                                     min_count=min_count)
+        count = np.asarray(state.count).astype(np.int64).copy()
+        keysum = np.asarray(state.keysum).astype(np.uint32).copy()
+        fpsum = np.asarray(state.fpsum).astype(np.uint32).copy()
+    else:
+        count, keysum, fpsum = state
+        count = np.asarray(count).astype(np.int64).copy()
+        keysum = np.asarray(keysum).astype(np.uint32).copy()
+        fpsum = np.asarray(fpsum).astype(np.uint32).copy()
+        log2_buckets = int(count.shape[1]).bit_length() - 1
+    return _finish(count, keysum, fpsum, log2_buckets, {}, host_sweeps,
+                   min_count)
+
+
+# ---------------------------------------------------------------------------
+# Priority classes (PSketch, arxiv 2509.07338): per-tenant accuracy
+# classes under one fixed memory budget
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class InvClass:
+    """One accuracy class: its own bucket geometry and the tenant
+    (mntns) set it serves. `tenants is None` marks the '*' catch-all."""
+
+    name: str
+    log2_buckets: int
+    tenants: tuple[int, ...] | None
+
+    @property
+    def is_default(self) -> bool:
+        return self.tenants is None
+
+
+def parse_priority_classes(text: str) -> list[InvClass]:
+    """Parse ``name=log2buckets:tenant|tenant,...`` (one class must take
+    ``*``, the catch-all). Raises ValueError naming the offending class
+    on any malformed entry — the loud-validation contract."""
+    classes: list[InvClass] = []
+    names: set[str] = set()
+    tenants_seen: dict[int, str] = {}
+    defaults = 0
+    if not text.strip():
+        raise ValueError("empty priority-classes spec")
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            raise ValueError("empty class entry (stray comma?)")
+        if "=" not in part:
+            raise ValueError(f"class {part!r}: expected "
+                             "name=log2buckets:tenants")
+        name, rest = part.split("=", 1)
+        name = name.strip()
+        if not name:
+            raise ValueError(f"class {part!r}: empty class name")
+        if name in names:
+            raise ValueError(f"duplicate class name {name!r}")
+        names.add(name)
+        if ":" not in rest:
+            raise ValueError(f"class {name!r}: expected "
+                             "log2buckets:tenants after '='")
+        lb_s, ten_s = rest.split(":", 1)
+        try:
+            lb = int(lb_s)
+        except ValueError:
+            raise ValueError(f"class {name!r}: log2buckets {lb_s!r} is "
+                             "not an integer") from None
+        if not 6 <= lb <= 20:
+            raise ValueError(f"class {name!r}: log2buckets {lb} outside "
+                             "[6, 20]")
+        ten_s = ten_s.strip()
+        if ten_s == "*":
+            defaults += 1
+            if defaults > 1:
+                raise ValueError(f"class {name!r}: second '*' catch-all "
+                                 "(exactly one default class)")
+            classes.append(InvClass(name=name, log2_buckets=lb,
+                                    tenants=None))
+            continue
+        tenants: list[int] = []
+        for t in ten_s.split("|"):
+            t = t.strip()
+            if not t:
+                raise ValueError(f"class {name!r}: empty tenant entry")
+            try:
+                tv = int(t)
+            except ValueError:
+                raise ValueError(f"class {name!r}: tenant {t!r} is not a "
+                                 "mntns integer") from None
+            if tv in tenants_seen:
+                raise ValueError(
+                    f"class {name!r}: tenant {tv} already claimed by "
+                    f"class {tenants_seen[tv]!r}")
+            tenants_seen[tv] = name
+            tenants.append(tv)
+        if not tenants:
+            raise ValueError(f"class {name!r}: no tenants")
+        classes.append(InvClass(name=name, log2_buckets=lb,
+                                tenants=tuple(tenants)))
+    if defaults == 0:
+        raise ValueError("no '*' catch-all class — every stream needs a "
+                         "home (add e.g. rest=<log2b>:*)")
+    return classes
+
+
+def validate_class_budget(classes: list[InvClass], *, rows: int,
+                          log2_buckets: int) -> None:
+    """The classes PARTITION the base geometry's memory: sum of per-class
+    state bytes must fit inside inv-rows × 2^inv-log2-buckets — priority
+    is a reallocation, never a growth. Raises ValueError with the exact
+    byte arithmetic."""
+    budget = inv_bytes(rows, log2_buckets)
+    spent = sum(inv_bytes(rows, c.log2_buckets) for c in classes)
+    if spent > budget:
+        detail = " + ".join(
+            f"{c.name}:{inv_bytes(rows, c.log2_buckets)}" for c in classes)
+        raise ValueError(
+            f"priority classes need {spent} bytes ({detail}) but the "
+            f"base geometry budgets {budget} (inv-rows {rows} x "
+            f"2^{log2_buckets} buckets x 3 lanes x 4B) — shrink a class "
+            "or grow inv-log2-buckets")
+
+
+def class_weights(classes: list[InvClass], mntns: np.ndarray,
+                  weights: np.ndarray) -> list[np.ndarray]:
+    """Per-class effective weight vectors for one batch: an event's
+    weight lands in exactly one class (its tenant's, else the '*'
+    catch-all), so summing per-class decodes reproduces whole-stream
+    totals exactly."""
+    mntns = np.asarray(mntns)
+    weights = np.asarray(weights)
+    claimed = np.zeros(mntns.shape, bool)
+    out: list[np.ndarray] = []
+    masks: list[np.ndarray] = []
+    for c in classes:
+        if c.is_default:
+            masks.append(None)
+            continue
+        m = np.isin(mntns, np.asarray(c.tenants, dtype=mntns.dtype))
+        claimed |= m
+        masks.append(m)
+    for c, m in zip(classes, masks):
+        if m is None:
+            m = ~claimed
+        out.append((weights * m).astype(np.uint32))
+    return out
+
+
+__all__ = [
+    "FP_SALT", "INV_ROW_OFFSET", "InvClass", "InvDecode", "InvSketch",
+    "class_weights", "inv_bucket", "inv_bytes", "inv_capacity",
+    "inv_decode", "inv_decode_device", "inv_decode_finish",
+    "inv_fingerprint", "inv_init",
+    "inv_merge", "inv_psum", "inv_update", "modinv32_odd",
+    "parse_priority_classes", "validate_class_budget",
+]

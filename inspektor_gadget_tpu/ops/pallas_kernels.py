@@ -96,34 +96,44 @@ def xla_histogram(keys: jnp.ndarray, weights: jnp.ndarray, *,
 
 
 # ---------------------------------------------------------------------------
-# Fused bundle_update kernel (ISSUE 10 tentpole).
+# Fused bundle_update kernel (ISSUE 10 tentpole; invertible planes ISSUE 15).
 #
 # SketchLib / NitroSketch observation: the order-of-magnitude win is ONE
 # pass over the staged batch updating every sketch plane, instead of one
 # dispatched op per sketch. This kernel folds the three histogram-shaped
-# planes (depth count-min rows + the entropy buckets) and the HLL
-# register-max plane into a single pallas_call:
+# planes (depth count-min rows + the entropy buckets), the HLL
+# register-max plane, and (when configured) the invertible sketch's
+# count/key-sum/fingerprint lanes into a single pallas_call:
 #
-#   grid = (n_planes, Wmax/W_TILE), n_planes = depth + 2
+#   grid = (n_planes, Wmax/W_TILE), n_planes = depth + 2 + 3*inv_rows
 #   plane 0..depth-1   CMS row d:  h = fmix32(hh * mult_d + salt_d)
 #   plane depth        entropy:    h = fmix32(dist * mult_0)
 #   plane depth+1      HLL:        h = fmix32(distinct); value = rank,
 #                                  combined by MAX instead of ADD
+#   plane depth+2+3r+l invertible row r, lane l ∈ {count, keysum,
+#                                  fpsum}: uint32 accumulation (wraps
+#                                  mod 2^32 — the invertible algebra),
+#                                  bitcast to f32 bits for the output
 #
 # Every plane is padded to the widest plane's tile count so the grid and
 # index maps stay trivial; tiles past a narrow plane's real width can
 # never match a bucket index and write zero blocks that the host-side
 # wrapper slices off (bounded wasted VPU work, shape-generic kernel).
-# Accumulation is f32 — exact for per-batch bucket deltas < 2^24 (the
-# staged batch is <= 2^17 rows), so casting the deltas back to the
-# sketches' int32 state is bit-identical to the reference scatter path;
-# the parity tier in tests/test_sketches.py holds both to that contract.
+# Histogram accumulation is f32 — exact for per-batch bucket deltas
+# < 2^24 (the staged batch is <= 2^17 rows), so casting the deltas back
+# to the sketches' int32 state is bit-identical to the reference scatter
+# path. The invertible lanes accumulate IN uint32 on the VPU (key*weight
+# products overflow f32's 24-bit mantissa, and mod-2^32 wrap is the
+# semantics, not an error), so they are bit-identical by construction;
+# the parity tier in tests/test_sketches.py holds every path to that
+# contract.
 # ---------------------------------------------------------------------------
 
 
 def _fused_kernel(hh_ref, distinct_ref, dist_ref, w_ref, out_ref, *,
                   depth: int, log2_width: int, ent_log2_width: int,
-                  hll_p: int, n_chunks: int):
+                  hll_p: int, inv_rows: int, inv_log2_buckets: int,
+                  n_chunks: int):
     plane = pl.program_id(0)
     tile = pl.program_id(1)
 
@@ -173,37 +183,94 @@ def _fused_kernel(hh_ref, distinct_ref, dist_ref, w_ref, out_ref, *,
         return jnp.maximum(acc, contrib.max(axis=0, keepdims=True))
 
     zero = jnp.zeros((1, W_TILE), jnp.float32)
-    acc = jax.lax.cond(
-        plane == depth + 1,
-        lambda: jax.lax.fori_loop(0, n_chunks, hll_body, zero),
-        lambda: jax.lax.fori_loop(0, n_chunks, hist_body, zero))
+
+    def run_hll():
+        return jax.lax.fori_loop(0, n_chunks, hll_body, zero)
+
+    def run_hist():
+        return jax.lax.fori_loop(0, n_chunks, hist_body, zero)
+
+    if inv_rows:
+        # invertible planes: bucket-hash parameters per ROW (3 planes
+        # share a row), the lane kind (count/keysum/fpsum) selected by
+        # plane id mod 3; all arithmetic uint32 so the mod-2^32 wrap the
+        # decode inverts happens natively, then the accumulator's bits
+        # ride the f32 output via bitcast (memory moves only — no f32
+        # arithmetic ever touches them)
+        from .invertible import FP_SALT, INV_ROW_OFFSET
+        inv_base = depth + 2
+
+        def sel_inv(vals):
+            out = jnp.uint32(vals[-1])
+            for i in range(len(vals) - 2, -1, -1):
+                out = jnp.where(plane == inv_base + i, jnp.uint32(vals[i]),
+                                out)
+            return out
+
+        imult = sel_inv([int(_row_multiplier(INV_ROW_OFFSET + p // 3))
+                         for p in range(3 * inv_rows)])
+        isalt = sel_inv([((INV_ROW_OFFSET + p // 3) * 0x9E3779B9)
+                         & 0xFFFFFFFF for p in range(3 * inv_rows)])
+        lane = (plane - inv_base) % 3
+
+        def inv_body(c, acc):
+            keys = hh_ref[c, :].astype(jnp.uint32)
+            wu = w_ref[c, :].astype(jnp.uint32)
+            h = _fmix32(keys * imult + isalt)
+            idx = (h >> (32 - inv_log2_buckets)).astype(jnp.int32)
+            local = idx - tile * W_TILE
+            fpv = _fmix32(keys ^ jnp.uint32(FP_SALT))
+            val = jnp.where(lane == 0, wu,
+                            jnp.where(lane == 1, keys * wu, fpv * wu))
+            contrib = jnp.where(local[:, None] == iota, val[:, None],
+                                jnp.uint32(0))
+            return acc + contrib.sum(axis=0, keepdims=True)
+
+        def run_inv():
+            acc_u = jax.lax.fori_loop(
+                0, n_chunks, inv_body, jnp.zeros((1, W_TILE), jnp.uint32))
+            return jax.lax.bitcast_convert_type(acc_u, jnp.float32)
+
+        acc = jax.lax.cond(
+            plane >= inv_base, run_inv,
+            lambda: jax.lax.cond(plane == depth + 1, run_hll, run_hist))
+    else:
+        acc = jax.lax.cond(plane == depth + 1, run_hll, run_hist)
     out_ref[0, 0, :, :] = acc.reshape(8, 128)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "depth", "log2_width", "ent_log2_width", "hll_p", "interpret"))
+    "depth", "log2_width", "ent_log2_width", "hll_p", "inv_rows",
+    "inv_log2_buckets", "interpret"))
 def fused_sketch_planes(hh_keys: jnp.ndarray, distinct_keys: jnp.ndarray,
                         dist_keys: jnp.ndarray, weights: jnp.ndarray, *,
                         depth: int, log2_width: int, ent_log2_width: int,
-                        hll_p: int, interpret: bool = False
-                        ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                        hll_p: int, inv_rows: int = 0,
+                        inv_log2_buckets: int = 0, interpret: bool = False
+                        ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                   jnp.ndarray | None]:
     """One fused pass over the staged batch → per-plane state deltas:
     (cms_delta (depth, W) f32, ent_delta (2**ent_log2_width,) f32,
-    hll_batch_ranks (2**hll_p,) f32). n must be a multiple of N_CHUNK and
-    the WIDEST plane a multiple of W_TILE (pad the sketch config, not the
-    data). `interpret=True` runs the kernel in the Pallas interpreter —
-    how the parity tier exercises the kernel math on CPU CI."""
+    hll_batch_ranks (2**hll_p,) f32, inv_delta (inv_rows, 3,
+    2**inv_log2_buckets) uint32 or None). The invertible deltas come
+    back already bitcast to uint32 with lanes ordered (count, keysum,
+    fpsum) per row. n must be a multiple of N_CHUNK and the WIDEST plane
+    a multiple of W_TILE (pad the sketch config, not the data).
+    `interpret=True` runs the kernel in the Pallas interpreter — how the
+    parity tier exercises the kernel math on CPU CI."""
     n = hh_keys.shape[0]
-    wmax = max(1 << log2_width, 1 << ent_log2_width, 1 << hll_p)
+    wmax = max(1 << log2_width, 1 << ent_log2_width, 1 << hll_p,
+               (1 << inv_log2_buckets) if inv_rows else 0)
     assert n % N_CHUNK == 0 and wmax % W_TILE == 0
     n_chunks = n // N_CHUNK
-    n_planes = depth + 2
+    n_planes = depth + 2 + 3 * inv_rows
     tiles = wmax // W_TILE
     shape2 = (n_chunks, N_CHUNK)
     w2 = weights.astype(jnp.float32).reshape(shape2)
     kernel = functools.partial(
         _fused_kernel, depth=depth, log2_width=log2_width,
-        ent_log2_width=ent_log2_width, hll_p=hll_p, n_chunks=n_chunks)
+        ent_log2_width=ent_log2_width, hll_p=hll_p, inv_rows=inv_rows,
+        inv_log2_buckets=inv_log2_buckets, n_chunks=n_chunks)
     batch_spec = pl.BlockSpec(shape2, lambda p, t: (0, 0))
     out = pl.pallas_call(
         kernel,
@@ -216,6 +283,13 @@ def fused_sketch_planes(hh_keys: jnp.ndarray, distinct_keys: jnp.ndarray,
     )(hh_keys.reshape(shape2), distinct_keys.reshape(shape2),
       dist_keys.reshape(shape2), w2)
     out = out.reshape(n_planes, wmax)
+    inv_delta = None
+    if inv_rows:
+        inv_bits = out[depth + 2:, :1 << inv_log2_buckets]
+        inv_delta = jax.lax.bitcast_convert_type(
+            inv_bits, jnp.uint32).reshape(inv_rows, 3,
+                                          1 << inv_log2_buckets)
     return (out[:depth, :1 << log2_width],
             out[depth, :1 << ent_log2_width],
-            out[depth + 1, :1 << hll_p])
+            out[depth + 1, :1 << hll_p],
+            inv_delta)

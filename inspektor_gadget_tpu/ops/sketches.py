@@ -26,6 +26,7 @@ from .countmin import CountMin, cms_init, cms_merge, cms_update
 from .entropy import (EntropySketch, entropy_estimate, entropy_init,
                       entropy_merge, entropy_update)
 from .hll import HLL, hll_estimate, hll_init, hll_merge, hll_update
+from .invertible import InvSketch, inv_init, inv_merge, inv_update
 from .topk import TopK, topk_init, topk_merge, topk_update
 
 
@@ -37,6 +38,11 @@ class SketchBundle:
     topk: TopK
     events: jnp.ndarray  # () float32 — total events absorbed (masked count)
     drops: jnp.ndarray   # () float32 — upstream loss accounting carried along
+    # invertible heavy-key plane (ISSUE 15): None for configs without it,
+    # so every pre-existing treedef (and every level-0 window digest of a
+    # plane-off config) is unchanged; when present it rides every merge
+    # path for free — pairwise adds, cluster psum, lane stacking
+    inv: InvSketch | None = None
 
 
 def bundle_init(
@@ -46,6 +52,8 @@ def bundle_init(
     hll_p: int = 14,
     entropy_log2_width: int = 12,
     k: int = 128,
+    inv_rows: int = 0,
+    inv_log2_buckets: int = 12,
 ) -> SketchBundle:
     return SketchBundle(
         cms=cms_init(depth, log2_width),
@@ -54,6 +62,7 @@ def bundle_init(
         topk=topk_init(k),
         events=jnp.zeros((), jnp.float32),
         drops=jnp.zeros((), jnp.float32),
+        inv=(inv_init(inv_rows, inv_log2_buckets) if inv_rows else None),
     )
 
 
@@ -74,6 +83,8 @@ def bundle_update(
         topk=topk_update(bundle.topk, cms, hh_keys, mask),
         events=bundle.events + mask.sum(dtype=jnp.float32),
         drops=bundle.drops + (drops if drops is not None else 0.0),
+        inv=(inv_update(bundle.inv, hh_keys, w)
+             if bundle.inv is not None else None),
     )
 
 
@@ -86,6 +97,8 @@ def bundle_merge(a: SketchBundle, b: SketchBundle) -> SketchBundle:
         topk=topk_merge(a.topk, b.topk, cms),
         events=a.events + b.events,
         drops=a.drops + b.drops,
+        inv=(inv_merge(a.inv, b.inv)
+             if a.inv is not None and b.inv is not None else None),
     )
 
 
@@ -106,10 +119,13 @@ bundle_update_jit = jax.jit(bundle_update, donate_argnums=0)
 def fused_supported(bundle: SketchBundle, n: int) -> bool:
     """Shape gate for the fused kernel: batch rows must tile into MXU
     chunks and the widest plane into lane tiles (pad the config, not the
-    data); odd shapes take the reference path automatically."""
+    data); odd shapes take the reference path automatically. The
+    invertible plane (when present) counts toward the widest plane like
+    every other lane."""
     from .pallas_kernels import N_CHUNK, W_TILE
     wmax = max(bundle.cms.width, bundle.entropy.counts.shape[0],
-               bundle.hll.registers.shape[0])
+               bundle.hll.registers.shape[0],
+               bundle.inv.buckets if bundle.inv is not None else 0)
     return n % N_CHUNK == 0 and wmax % W_TILE == 0
 
 
@@ -131,14 +147,27 @@ def _bundle_update_pallas(
     bundle_update_fused below."""
     from .pallas_kernels import fused_sketch_planes
     w_i32 = mask.astype(jnp.int32)
-    cms_d, ent_d, ranks = fused_sketch_planes(
+    inv_rows = bundle.inv.rows if bundle.inv is not None else 0
+    inv_lb = bundle.inv.log2_buckets if bundle.inv is not None else 0
+    cms_d, ent_d, ranks, inv_d = fused_sketch_planes(
         hh_keys, distinct_keys, dist_keys, w_i32,
         depth=bundle.cms.depth, log2_width=bundle.cms.log2_width,
         ent_log2_width=bundle.entropy.log2_width, hll_p=bundle.hll.p,
+        inv_rows=inv_rows, inv_log2_buckets=inv_lb,
         interpret=interpret)
     cms = bundle.cms.replace(
         table=bundle.cms.table + cms_d.astype(bundle.cms.table.dtype),
         total=bundle.cms.total + w_i32.sum().astype(jnp.float32))
+    inv = None
+    if bundle.inv is not None:
+        # the kernel already accumulated in uint32 (wraps mod 2^32 — the
+        # invertible algebra itself), so the adds below are the same
+        # integer adds the reference scatter path performs, bit for bit;
+        # the count delta fits int32 (per-batch weight sums << 2^31)
+        inv = bundle.inv.replace(
+            count=bundle.inv.count + inv_d[:, 0].astype(jnp.int32),
+            keysum=bundle.inv.keysum + inv_d[:, 1],
+            fpsum=bundle.inv.fpsum + inv_d[:, 2])
     return bundle.replace(
         cms=cms,
         hll=bundle.hll.replace(registers=jnp.maximum(
@@ -148,6 +177,7 @@ def _bundle_update_pallas(
         topk=topk_update(bundle.topk, cms, hh_keys, mask),
         events=bundle.events + mask.sum(dtype=jnp.float32),
         drops=bundle.drops + (drops if drops is not None else 0.0),
+        inv=inv,
     )
 
 
@@ -299,12 +329,13 @@ def bundle_digest(b: SketchBundle) -> jnp.ndarray:
     """Harvest digest as ONE u32 array so a harvest tick costs a single
     D2H transfer instead of six (each device→host read through the axon
     tunnel runs tens of ms — six per tick was ~40% of config-1's wall
-    clock). Layout: [bitcast_f32(events, drops, distinct, entropy_bits),
-    topk keys..k, topk counts..k (cast, exact)]. Decode with
-    decode_digest()."""
+    clock). Layout: [bitcast_f32(events, drops, distinct, entropy_bits,
+    candidate_overflow), topk keys..k, topk counts..k (cast, exact)].
+    Decode with decode_digest()."""
     meta = jnp.stack([b.events, b.drops,
                       hll_estimate(b.hll).astype(jnp.float32),
-                      entropy_estimate(b.entropy).astype(jnp.float32)])
+                      entropy_estimate(b.entropy).astype(jnp.float32),
+                      b.topk.overflow.astype(jnp.float32)])
     return jnp.concatenate([
         jax.lax.bitcast_convert_type(meta, jnp.uint32),
         b.topk.keys,
@@ -323,12 +354,13 @@ def bundle_digest(b: SketchBundle) -> jnp.ndarray:
 bundle_digest_jit = jax.jit(bundle_digest, donate_argnums=())
 
 
-def decode_digest(digest) -> tuple[float, float, float, float,
+def decode_digest(digest) -> tuple[float, float, float, float, bool,
                                    np.ndarray, np.ndarray]:
     """Host-side decode of bundle_digest's packed array →
-    (events, drops, distinct, entropy_bits, topk_keys_u32, topk_counts)."""
+    (events, drops, distinct, entropy_bits, candidate_overflow,
+    topk_keys_u32, topk_counts)."""
     d = np.asarray(digest)
-    meta = d[:4].view(np.float32)
-    k = (d.size - 4) // 2
+    meta = d[:5].view(np.float32)
+    k = (d.size - 5) // 2
     return (float(meta[0]), float(meta[1]), float(meta[2]), float(meta[3]),
-            d[4:4 + k], d[4 + k:].astype(np.int64))
+            bool(meta[4] > 0), d[5:5 + k], d[5 + k:].astype(np.int64))

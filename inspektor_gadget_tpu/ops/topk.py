@@ -9,6 +9,17 @@ estimate via jax.lax.top_k — all static shapes, fully jittable.
 
 Distributed merge: all_gather candidate tables over the mesh axis, refresh
 against the psum-merged CMS, re-take top-k.
+
+Approximation accounting (ISSUE 15 satellite): the candidate re-rank is
+EXACT while the distinct candidate population never exceeds k — the table
+then retains every key ever seen. The `overflow` flag latches 1 the first
+time a dedupe sees more than k live unique keys, on every path the same
+way: a single-chip fold flags at the step the (k+1)-th distinct candidate
+arrives, a merge flags when the union exceeds k (or any input already
+flagged, via max), and the collective harvest pmax-folds per-lane flags —
+so the flag means exactly "the candidate population exceeded k" at any
+chip/node count, and harvested summaries surface it as `approx` instead
+of silently degrading.
 """
 
 from __future__ import annotations
@@ -22,18 +33,23 @@ from .countmin import CountMin, cms_query
 
 @flax.struct.dataclass
 class TopK:
-    keys: jnp.ndarray    # (k,) uint32 candidate keys (0 = empty slot)
-    counts: jnp.ndarray  # (k,) int32 estimated counts
+    keys: jnp.ndarray      # (k,) uint32 candidate keys (0 = empty slot)
+    counts: jnp.ndarray    # (k,) int32 estimated counts
+    overflow: jnp.ndarray  # () int32 flag: candidate population ever > k
 
 
 def topk_init(k: int = 128) -> TopK:
-    return TopK(keys=jnp.zeros(k, dtype=jnp.uint32), counts=jnp.zeros(k, dtype=jnp.int32))
+    return TopK(keys=jnp.zeros(k, dtype=jnp.uint32),
+                counts=jnp.zeros(k, dtype=jnp.int32),
+                overflow=jnp.zeros((), dtype=jnp.int32))
 
 
-def _dedupe_topk(keys: jnp.ndarray, counts: jnp.ndarray, k: int) -> TopK:
+def _dedupe_topk(keys: jnp.ndarray, counts: jnp.ndarray, k: int,
+                 overflow: jnp.ndarray) -> TopK:
     """Keep the best-counted unique keys: sort by (key, -count) to group
     duplicates with each run's max count first, keep the first of each run,
-    then top_k by count."""
+    then top_k by count. Latches `overflow` when more than k distinct live
+    keys competed — the moment the candidate ring stops being exact."""
     order = jnp.lexsort((-counts, keys))
     sk, sc = keys[order], counts[order]
     first = jnp.concatenate([jnp.array([True]), sk[1:] != sk[:-1]])
@@ -42,9 +58,12 @@ def _dedupe_topk(keys: jnp.ndarray, counts: jnp.ndarray, k: int) -> TopK:
     top_counts, top_idx = jax.lax.top_k(sc, k)
     top_keys = sk[top_idx]
     empty = top_counts < 0
+    overflow = jnp.maximum(
+        overflow, (valid.sum(dtype=jnp.int32) > k).astype(jnp.int32))
     return TopK(
         keys=jnp.where(empty, jnp.uint32(0), top_keys),
         counts=jnp.where(empty, 0, top_counts),
+        overflow=overflow,
     )
 
 
@@ -57,7 +76,7 @@ def topk_update(state: TopK, cms: CountMin, batch_keys: jnp.ndarray,
     all_keys = jnp.concatenate([state.keys, bk])
     est = cms_query(cms, all_keys)
     est = jnp.where(all_keys == 0, -1, est).astype(jnp.int32)
-    return _dedupe_topk(all_keys, est, state.keys.shape[0])
+    return _dedupe_topk(all_keys, est, state.keys.shape[0], state.overflow)
 
 
 def topk_merge(a: TopK, b: TopK, cms: CountMin | None = None) -> TopK:
@@ -66,14 +85,16 @@ def topk_merge(a: TopK, b: TopK, cms: CountMin | None = None) -> TopK:
         counts = jnp.where(keys == 0, -1, cms_query(cms, keys)).astype(jnp.int32)
     else:
         counts = jnp.concatenate([a.counts, b.counts])
-    return _dedupe_topk(keys, counts, a.keys.shape[0])
+    return _dedupe_topk(keys, counts, a.keys.shape[0],
+                        jnp.maximum(a.overflow, b.overflow))
 
 
 def topk_gather_merge(state: TopK, cms_merged: CountMin, axis_name: str) -> TopK:
     """Mesh-wide merge: all_gather candidates, refresh vs merged CMS, re-rank."""
     keys = jax.lax.all_gather(state.keys, axis_name).reshape(-1)
     counts = jnp.where(keys == 0, -1, cms_query(cms_merged, keys)).astype(jnp.int32)
-    return _dedupe_topk(keys, counts, state.keys.shape[0])
+    return _dedupe_topk(keys, counts, state.keys.shape[0],
+                        jax.lax.pmax(state.overflow, axis_name))
 
 
 def topk_values(state: TopK) -> tuple[jnp.ndarray, jnp.ndarray]:

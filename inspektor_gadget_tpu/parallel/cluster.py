@@ -35,6 +35,7 @@ from ..models.autoencoder import (
 from ..ops.countmin import cms_psum
 from ..ops.entropy import entropy_psum
 from ..ops.hll import hll_pmax
+from ..ops.invertible import inv_psum
 from ..ops.sketches import SketchBundle, bundle_init, bundle_update
 from ..ops.topk import topk_gather_merge
 from .compat import shard_map
@@ -120,7 +121,8 @@ def cluster_sketch_step(
 def cluster_merge(bundle: SketchBundle) -> SketchBundle:
     """Collective merge of per-node bundles into the cluster view (runs
     under shard_map over the node axis). CMS/entropy psum, HLL pmax, top-k
-    all_gather + re-rank vs the merged CMS."""
+    all_gather + re-rank vs the merged CMS, invertible lanes psum (the
+    whole point of the invertible plane: decode runs on THIS state)."""
     local = jax.tree.map(lambda x: x[0], bundle)
     cms = cms_psum(local.cms, NODE_AXIS)
     merged = SketchBundle(
@@ -130,6 +132,8 @@ def cluster_merge(bundle: SketchBundle) -> SketchBundle:
         topk=topk_gather_merge(local.topk, cms, NODE_AXIS),
         events=jax.lax.psum(local.events, NODE_AXIS),
         drops=jax.lax.psum(local.drops, NODE_AXIS),
+        inv=(inv_psum(local.inv, NODE_AXIS)
+             if local.inv is not None else None),
     )
     return merged
 

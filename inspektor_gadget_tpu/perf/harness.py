@@ -142,6 +142,7 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                 replay: str | None = None,
                 pipeline: str = "fused",
                 chips: int = 1,
+                invertible: bool = False,
                 extra_provenance_probe: dict | None = None) -> dict:
     """Run one harness config; returns a validated PerfRecord dict.
 
@@ -159,6 +160,15 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     library is available; otherwise it folds the pure-Python source
     inside the pop_folded stage and says so in extra.pipeline.
 
+    `invertible` adds the invertible heavy-key plane to the bundle (the
+    fused step absorbs it as extra kernel planes; the record stays in
+    the SAME ledger series with extra.invertible naming the shape — the
+    acceptance comparison is host-plane throughput within the baseline
+    band). Two extra stages land in the record: inv_decode times a real
+    decode of the live state at every harvest tick, and inv_update a
+    post-loop micro-measurement of the standalone invertible update (the
+    merge-stage pattern).
+
     The caller decides whether it lands in the ledger (cli/bench.py
     appends by default; tests pass their own tmp path)."""
     cfg = HARNESS_CONFIGS.get(config)
@@ -175,6 +185,10 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
         raise ValueError("pipeline=sharded does not take --replay yet "
                          "(replay determinism through the sharded path is "
                          "covered by the operator tier)")
+    if invertible and pipeline == "sharded":
+        raise ValueError("--invertible measures the single-chip fused/"
+                         "classic arms (the sharded arm's per-chip number "
+                         "comes from the same fused step)")
     _tm_runs.labels(config=config).inc()
     window = cfg["seconds"] if seconds is None else float(seconds)
 
@@ -230,11 +244,15 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                       "pure-python fallback", e)
             native_gen = None
 
+    inv_rows = 3 if invertible else 0
+    inv_lb = min(12, cfg["log2_width"]) if invertible else 12
+
     def new_bundle():
         return bundle_init(depth=cfg["depth"], log2_width=cfg["log2_width"],
                            hll_p=cfg["hll_p"],
                            entropy_log2_width=cfg["entropy_log2_width"],
-                           k=cfg["k"])
+                           k=cfg["k"], inv_rows=inv_rows,
+                           inv_log2_buckets=inv_lb)
 
     # the shared staged-ingest step (update + fence token + weights-lane
     # semantics — the donation/fence contract is documented once, on
@@ -363,6 +381,13 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                     np.asarray(hh_counts)
                     float(hll_estimate(bundle.hll))
                     float(entropy_estimate(bundle.entropy))
+                if invertible:
+                    # a REAL decode of the live merged state per harvest
+                    # tick — the cost a consumer of decoded heavy keys
+                    # actually pays (device peel + host finisher)
+                    with clock.stage("inv_decode", spans):
+                        from ..ops.invertible import inv_decode
+                        inv_decode(bundle.inv, device_sweeps=2, cap=512)
         final_stage = "fused_update" if pipeline == "fused" else "bundle_update"
         with clock.stage(final_stage, steps < SPAN_BATCHES):
             jax.block_until_ready(bundle.events)
@@ -379,6 +404,23 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
         for _ in range(cfg["merges"]):
             with clock.stage("merge", True):
                 jax.block_until_ready(merge_jit(bundle, other).events)
+
+        if invertible:
+            # standalone invertible update at this batch shape (the
+            # post-loop micro-measurement pattern the merge stage uses):
+            # on the hot path the fused kernel absorbs these planes, so
+            # this isolates what the plane itself costs per batch
+            from ..ops.invertible import inv_init, inv_update
+            inv_step = jax.jit(inv_update, donate_argnums=0)
+            inv_s = inv_init(inv_rows, inv_lb)
+            ik = jnp.asarray(np.arange(1, batch_n + 1, dtype=np.uint32))
+            iw = jnp.ones(batch_n, jnp.int32)
+            inv_s = inv_step(inv_s, ik, iw)
+            jax.block_until_ready(inv_s.count)  # compile
+            for _ in range(cfg["merges"]):
+                with clock.stage("inv_update", True):
+                    inv_s = inv_step(inv_s, ik, iw)
+                    jax.block_until_ready(inv_s.count)
 
         run_span.set_attr("events", events)
         run_span.set_attr("ev_per_s", round(events / max(elapsed, 1e-9), 1))
@@ -426,13 +468,18 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
         events / max(host_secs, 1e-9), 1)
     impl = ("native" if native_gen is not None
             else "replay" if replay_src is not None else "py")
+    inv_tag = "+inv" if invertible else ""
     if pipeline == "fused":
         extra_fields["pipeline"] = (
             f"pop_folded({'py-fold' if impl == 'py' else impl})"
-            "->h2d_overlap(depth2)->fused_update")
+            f"->h2d_overlap(depth2)->fused_update{inv_tag}")
     else:
         extra_fields["pipeline"] = (
-            f"pop({impl})->decode->enrich->fold32->h2d->bundle_update")
+            f"pop({impl})->decode->enrich->fold32->h2d"
+            f"->bundle_update{inv_tag}")
+    if invertible:
+        extra_fields["invertible"] = True
+        extra_fields["inv_geometry"] = f"{inv_rows}x2^{inv_lb}"
     if replay_src is not None:
         # the journal digest IS part of the number's meaning: same
         # config + same digest → directly comparable records

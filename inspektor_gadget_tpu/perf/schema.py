@@ -35,9 +35,15 @@ SCHEMA_ID = "ig-tpu/perf-record/v1"
 #            per-device H2D puts assemble into one node-sharded global,
 #            and ONE shard_map step updates every chip's fused bundle;
 #            harvest is the only collective)
+#   invertible (ISSUE 15): inv_update measures the invertible plane's
+#            standalone device update (the fused kernel absorbs it as
+#            extra grid planes on the hot path — extra.invertible says
+#            the planes were on, the series key never forks), and
+#            inv_decode the pure-bucket peeling of merged state at
+#            harvest ticks
 STAGES = ("pop", "decode", "enrich", "fold32", "pop_folded", "h2d",
           "h2d_overlap", "h2d_lanes", "bundle_update", "fused_update",
-          "sharded_update", "harvest", "merge")
+          "sharded_update", "inv_update", "inv_decode", "harvest", "merge")
 
 # stages whose seconds count as HOST-plane ingest cost (the acceptance
 # comparison pop_folded→h2d vs pop→decode→enrich→fold32 sums these)

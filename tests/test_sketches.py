@@ -482,6 +482,277 @@ def test_window_digests_identical_on_fused_and_reference_paths():
     assert seal("reference") == seal("fused")
 
 
+# -- invertible heavy-key plane (ISSUE 15) -----------------------------------
+# Merge-algebra property tier: the invertible lanes are pure integer adds,
+# so every grouping/ordering of merges — pairwise host folds, device psum
+# collectives, window-level adds — must produce identical state, and
+# decode of that state must be exact whenever the distinct-key load fits
+# pure buckets (<= inv_capacity). Beyond capacity the documented envelope
+# is: recovered pairs stay exact, coverage degrades, complete=False.
+
+
+def _inv_filled(rng, n_keys, rows=3, log2b=10, vocab_hi=1 << 22):
+    """An InvSketch holding n_keys distinct keys with zipf-ish weights,
+    plus the ground-truth {key: count} map."""
+    import jax as _jax
+    from inspektor_gadget_tpu.ops.invertible import inv_init, inv_update
+
+    keys = rng.choice(np.arange(1, vocab_hi, dtype=np.uint32),
+                      size=n_keys, replace=False)
+    # cap at a value with few trailing zero bits: counts divisible by
+    # 2^17+ are the documented decode blind spot, and a power-of-two
+    # clip would manufacture exactly that pathology
+    counts = rng.zipf(1.5, size=n_keys).clip(1, 100_000).astype(np.int64)
+    step = _jax.jit(inv_update, donate_argnums=0)
+    s = step(inv_init(rows, log2b), jnp.asarray(keys),
+             jnp.asarray(counts.astype(np.int32)))
+    return s, dict(zip(keys.tolist(), counts.tolist()))
+
+
+def test_inv_merge_associative_and_commutative():
+    from inspektor_gadget_tpu.ops.invertible import inv_merge
+
+    rng = np.random.default_rng(31)
+    states = [_inv_filled(rng, 100)[0] for _ in range(3)]
+    a, b, c = states
+    ab_c = inv_merge(inv_merge(a, b), c)
+    a_bc = inv_merge(a, inv_merge(b, c))
+    for lane in ("count", "keysum", "fpsum"):
+        assert jnp.array_equal(getattr(ab_c, lane), getattr(a_bc, lane))
+    ab, ba = inv_merge(a, b), inv_merge(b, a)
+    for lane in ("count", "keysum", "fpsum"):
+        assert jnp.array_equal(getattr(ab, lane), getattr(ba, lane))
+
+
+def test_inv_psum_under_vmap_equals_pairwise_merge():
+    """Device all-reduce (the cluster/fleet merge path) ≡ host pairwise
+    merge — the two ways merged state is built may never diverge, or
+    decode answers would depend on WHERE the merge ran."""
+    from inspektor_gadget_tpu.ops.invertible import inv_merge, inv_psum
+
+    rng = np.random.default_rng(32)
+    a, _ = _inv_filled(rng, 80)
+    b, _ = _inv_filled(rng, 80)
+    stacked = jax.tree.map(lambda x, y: jnp.stack([x, y]), a, b)
+    out = jax.vmap(lambda s: inv_psum(s, "nodes"),
+                   axis_name="nodes")(stacked)
+    want = inv_merge(a, b)
+    for lane in ("count", "keysum", "fpsum"):
+        assert jnp.array_equal(getattr(out, lane)[0], getattr(want, lane))
+        assert jnp.array_equal(getattr(out, lane)[1], getattr(want, lane))
+
+
+def test_inv_decode_exact_when_keys_fit_pure_buckets():
+    """Under the documented capacity, decode recovers EVERY key with its
+    EXACT total weight (odd and even totals alike — the host finisher's
+    trailing-zero enumeration covers even pure buckets) and reports
+    complete=True. Also across a merge: decode(merge(a,b)) == union."""
+    from inspektor_gadget_tpu.ops.invertible import (inv_capacity,
+                                                     inv_decode, inv_merge)
+
+    rng = np.random.default_rng(33)
+    rows, log2b = 3, 10
+    cap = inv_capacity(rows, log2b)
+    assert cap == 3 * 1024 // 4
+    s, truth = _inv_filled(rng, cap // 2, rows=rows, log2b=log2b)
+    dec = inv_decode(s)
+    assert dec.complete and dec.residual_events == 0
+    assert dict(dec.keys) == truth
+    s2, truth2 = _inv_filled(rng, cap // 3, rows=rows, log2b=log2b)
+    merged_truth = dict(truth)
+    for k, c in truth2.items():
+        merged_truth[k] = merged_truth.get(k, 0) + c
+    dec2 = inv_decode(inv_merge(s, s2))
+    assert dec2.complete
+    assert dict(dec2.keys) == merged_truth
+
+
+def test_inv_decode_device_loop_matches_host_only_decode():
+    """The jittable fixed-iteration device loop + host finisher must
+    answer exactly like the pure-numpy peel over the same state."""
+    from inspektor_gadget_tpu.ops.invertible import inv_decode
+
+    rng = np.random.default_rng(34)
+    s, truth = _inv_filled(rng, 300)
+    via_device = inv_decode(s)                      # jnp leaves → device loop
+    host_only = inv_decode((np.asarray(s.count), np.asarray(s.keysum),
+                            np.asarray(s.fpsum)))   # numpy → host peel only
+    assert dict(via_device.keys) == dict(host_only.keys) == truth
+    assert via_device.complete and host_only.complete
+
+
+def test_inv_decode_error_envelope_on_zipf_overload():
+    """Past capacity the decode is PARTIAL, never wrong: every recovered
+    pair must match ground truth exactly, completeness is reported
+    False, and the undecoded mass is accounted in residual_events."""
+    from inspektor_gadget_tpu.ops.invertible import (inv_capacity,
+                                                     inv_decode)
+
+    rng = np.random.default_rng(35)
+    rows, log2b = 3, 8
+    cap = inv_capacity(rows, log2b)
+    s, truth = _inv_filled(rng, cap * 4, rows=rows, log2b=log2b)
+    dec = inv_decode(s)
+    assert not dec.complete
+    for k, c in dec.keys:
+        assert truth.get(k) == c, (k, c)
+    total = sum(truth.values())
+    recovered_mass = sum(c for _, c in dec.keys)
+    assert recovered_mass + dec.residual_events == total
+
+
+def test_fused_kernel_parity_with_invertible_planes():
+    """Interpret-mode fused kernel vs the reference composition with the
+    invertible planes ON: every bundle leaf — the new count/keysum/fpsum
+    lanes included — is bit-identical, over ragged masks and a second
+    batch on live state."""
+    from inspektor_gadget_tpu.ops.sketches import _bundle_update_pallas
+
+    rng = np.random.default_rng(36)
+    leaves = _BUNDLE_LEAVES + ("inv.count", "inv.keysum", "inv.fpsum",
+                               "topk.overflow")
+    for depth, log2w, entw, p, inv_rows, inv_lb, n, valid in (
+            (4, 10, 8, 8, 3, 9, 256, 256),
+            (2, 12, 10, 7, 2, 12, 512, 501),):
+        b0 = bundle_init(depth=depth, log2_width=log2w, hll_p=p,
+                         entropy_log2_width=entw, k=16,
+                         inv_rows=inv_rows, inv_log2_buckets=inv_lb)
+        hh, distinct, dist = _streams(rng, n)
+        mask = jnp.asarray(np.arange(n) < valid)
+        ref = bundle_update(b0, hh, distinct, dist, mask, jnp.float32(1))
+        fused = _bundle_update_pallas(b0, hh, distinct, dist, mask,
+                                      jnp.float32(1), interpret=True)
+        for name in leaves:
+            assert np.array_equal(_leaf(ref, name), _leaf(fused, name)), \
+                (name, depth, inv_rows)
+        hh2, d2, dd2 = _streams(rng, n)
+        ref2 = bundle_update(ref, hh2, d2, dd2, mask)
+        fused2 = _bundle_update_pallas(fused, hh2, d2, dd2, mask,
+                                       interpret=True)
+        for name in leaves:
+            assert np.array_equal(_leaf(ref2, name), _leaf(fused2, name)), \
+                ("second batch", name)
+
+
+def test_candidate_overflow_flag_flips_exactly_at_overflow():
+    """The approx flag (ISSUE 15 satellite): k distinct candidate keys
+    leave it 0 — the re-rank is exact; the (k+1)-th distinct key flips
+    it to 1, on update AND merge paths, and psum/merge never resets it."""
+    from inspektor_gadget_tpu.ops.sketches import decode_digest, bundle_digest
+
+    k = 8
+    n = 256
+    mask = jnp.ones(n, bool)
+
+    def feed(b, vocab):
+        keys = jnp.asarray((np.arange(n) % vocab + 1).astype(np.uint32))
+        return bundle_update(b, keys, keys, keys, mask)
+
+    b = bundle_init(depth=2, log2_width=10, hll_p=8,
+                    entropy_log2_width=8, k=k)
+    b = feed(b, k)                       # exactly k distinct
+    assert int(b.topk.overflow) == 0
+    assert decode_digest(bundle_digest(b))[4] is False
+    b = feed(b, k + 1)                   # the (k+1)-th distinct key
+    assert int(b.topk.overflow) == 1
+    assert decode_digest(bundle_digest(b))[4] is True
+    # merge paths: union overflow + latched inputs
+    a1 = feed(bundle_init(depth=2, log2_width=10, hll_p=8,
+                          entropy_log2_width=8, k=k), k)
+    a2keys = jnp.asarray((np.arange(n) % k + 100).astype(np.uint32))
+    a2 = bundle_update(bundle_init(depth=2, log2_width=10, hll_p=8,
+                                   entropy_log2_width=8, k=k),
+                       a2keys, a2keys, a2keys, mask)
+    assert int(a1.topk.overflow) == 0 and int(a2.topk.overflow) == 0
+    m = bundle_merge(a1, a2)             # union is 2k distinct > k
+    assert int(m.topk.overflow) == 1
+    m2 = bundle_merge(m, bundle_init(depth=2, log2_width=10, hll_p=8,
+                                     entropy_log2_width=8, k=k))
+    assert int(m2.topk.overflow) == 1    # latched through further merges
+
+
+def test_window_digest_invertible_plane_conditional():
+    """Digest discipline: a window without the invertible arrays hashes
+    exactly as before the plane existed (the fields never enter the
+    doc), and adding the arrays changes — removing them restores — the
+    digest, so plane-off replay `--verify` stays green."""
+    from inspektor_gadget_tpu.history import window_digest
+    from inspektor_gadget_tpu.history.window import (SealedWindow,
+                                                     decode_window,
+                                                     encode_window)
+
+    base = dict(
+        gadget="t", node="n", run_id="r", window=1, start_ts=1.0,
+        end_ts=2.0, events=10, drops=0,
+        cms=np.ones((2, 8), np.int32), hll=np.zeros(16, np.int32),
+        ent=np.zeros(8, np.float32),
+        topk_keys=np.array([5], np.uint32),
+        topk_counts=np.array([10], np.int64), slices={})
+    plain = SealedWindow(**base)
+    with_inv = SealedWindow(**base,
+                            inv_count=np.ones((2, 8), np.int32),
+                            inv_keysum=np.ones((2, 8), np.uint32),
+                            inv_fpsum=np.ones((2, 8), np.uint32))
+    assert window_digest(plain) != window_digest(with_inv)
+    stripped = SealedWindow(**base)
+    assert window_digest(plain) == window_digest(stripped)
+    # codec roundtrip preserves the plane bit-for-bit
+    h, payload = encode_window(with_inv)
+    back = decode_window(h, payload)
+    assert np.array_equal(back.inv_count, with_inv.inv_count)
+    assert np.array_equal(back.inv_keysum, with_inv.inv_keysum)
+    assert np.array_equal(back.inv_fpsum, with_inv.inv_fpsum)
+    assert window_digest(back) == window_digest(with_inv)
+
+
+def test_merge_windows_inv_plane_fold_and_refusal():
+    """Range-fold semantics: windows all carrying the plane fold into
+    decodable merged state (decode == union of per-window streams);
+    one window WITHOUT the plane disables decode for the range with a
+    loud note instead of decoding partial coverage."""
+    import jax as _jax
+    from inspektor_gadget_tpu.history import merge_windows
+    from inspektor_gadget_tpu.history.window import SealedWindow
+    from inspektor_gadget_tpu.ops.invertible import (inv_decode, inv_init,
+                                                     inv_update)
+
+    step = _jax.jit(inv_update, donate_argnums=0)
+    rng = np.random.default_rng(37)
+
+    def window_of(i, keys, counts, with_inv=True):
+        s = step(inv_init(2, 8), jnp.asarray(keys),
+                 jnp.asarray(counts.astype(np.int32)))
+        kw = {}
+        if with_inv:
+            kw = dict(inv_count=np.asarray(s.count),
+                      inv_keysum=np.asarray(s.keysum),
+                      inv_fpsum=np.asarray(s.fpsum))
+        return SealedWindow(
+            gadget="t", node="n", run_id="r", window=i,
+            start_ts=float(i), end_ts=float(i + 1),
+            events=int(counts.sum()), drops=0,
+            cms=np.zeros((2, 8), np.int32), hll=np.zeros(16, np.int32),
+            ent=np.zeros(8, np.float32),
+            topk_keys=np.zeros(4, np.uint32),
+            topk_counts=np.zeros(4, np.int64), slices={}, **kw)
+
+    k1 = rng.choice(np.arange(1, 1000, dtype=np.uint32), 40, replace=False)
+    c1 = rng.integers(1, 50, 40).astype(np.int64)
+    k2 = rng.choice(np.arange(1000, 2000, dtype=np.uint32), 30,
+                    replace=False)
+    c2 = rng.integers(1, 50, 30).astype(np.int64)
+    w1, w2 = window_of(1, k1, c1), window_of(2, k2, c2)
+    merged = merge_windows([w1, w2])
+    truth = dict(zip(k1.tolist(), c1.tolist()))
+    truth.update(zip(k2.tolist(), c2.tolist()))
+    assert dict(merged.heavy_flows()) == truth
+    # one plane-less window → decode disabled, loudly
+    merged2 = merge_windows([w1, window_of(3, k2, c2, with_inv=False)])
+    assert merged2.inv_count is None
+    assert merged2.heavy_flows() == []
+    assert any("heavy-flow decode disabled" in s for s in merged2.skipped)
+
+
 def test_windowed_cms_merge_and_jit():
     import jax as _jax
     from inspektor_gadget_tpu.ops.window import (
