@@ -182,6 +182,21 @@ class AlertEngine:
                     and v >= rule.threshold)
             base.append(v)
             return [("", v, trig)]
+        if rule.kind == "quantile_shift":
+            # latency regression vs the rolling baseline of the watched
+            # percentile (p50/p90/p99/p999). A 0.0 reading means the
+            # quantile plane is off or the window saw no events — that is
+            # "no observation", so it neither triggers nor enters the
+            # baseline (an idle window must not halve the baseline mean
+            # and turn the first busy window into a false shift)
+            v = fields[rule.field]
+            base = rs.baseline
+            mean = sum(base) / len(base) if base else 0.0
+            trig = (len(base) > 0 and mean > 0.0
+                    and v > rule.factor * mean and v >= rule.threshold)
+            if v > 0.0:
+                base.append(v)
+            return [("", v, trig)]
         if rule.kind == "heavy_hitter_churn":
             hh = (summary.get("heavy_hitters") if isinstance(summary, dict)
                   else summary.heavy_hitters) or []

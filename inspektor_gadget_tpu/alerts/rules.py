@@ -22,6 +22,13 @@ list of rule mappings or `{"rules": [...]}`:
         kind: anomaly_score       # one state machine per mntns slot
         threshold: 0.8
         severity: critical
+      - id: latency-regression
+        kind: quantile_shift      # pX vs the mean of the last `window`
+        field: p99                # p50 | p90 | p99 | p999 (default p99)
+        factor: 2.0               # degradation multiple that trips it
+        threshold: 1000           # optional absolute floor (value units, ns)
+        for: 100ms
+        severity: warning
 
 Everything is validated at LOAD time (ref: the round-5 stance that
 failures must be loud): unknown keys, unknown fields, non-numeric
@@ -38,7 +45,8 @@ import json
 from ..params.validators import parse_duration
 
 KINDS = ("threshold", "ratio", "entropy_jump", "cardinality_spike",
-         "heavy_hitter_churn", "anomaly_score", "heavy_flow")
+         "heavy_hitter_churn", "anomaly_score", "heavy_flow",
+         "quantile_shift")
 SEVERITIES = ("info", "warning", "critical")
 OPS = (">", ">=", "<", "<=")
 
@@ -46,7 +54,11 @@ OPS = (">", ">=", "<", "<=")
 # access point (summary_fields) keeps rules and the harvest shape in sync
 SUMMARY_FIELDS = ("events", "drops", "distinct", "entropy_bits",
                   "hh_top_count", "hh_top_share", "hh_count", "anomaly_max",
-                  "decoded_count")
+                  "decoded_count", "p50", "p90", "p99", "p999")
+
+# the percentiles a harvest's quantile block carries (operators/tpusketch
+# harvest → summary.quantiles); the only fields quantile_shift may watch
+QUANTILE_FIELDS = ("p50", "p90", "p99", "p999")
 
 
 def decoded_pairs(summary) -> list[tuple[int, int]]:
@@ -68,6 +80,7 @@ def summary_fields(summary) -> dict[str, float]:
         entropy = float(summary.get("entropy", summary.get("entropy_bits", 0.0)))
         hh = summary.get("heavy_hitters") or []
         anomaly = summary.get("anomaly") or {}
+        quantiles = summary.get("quantiles") or {}
     else:
         events = float(summary.events)
         drops = float(summary.drops)
@@ -75,6 +88,7 @@ def summary_fields(summary) -> dict[str, float]:
         entropy = float(summary.entropy_bits)
         hh = summary.heavy_hitters or []
         anomaly = summary.anomaly or {}
+        quantiles = getattr(summary, "quantiles", None) or {}
     top_count = float(hh[0][1]) if hh else 0.0
     return {
         "events": events,
@@ -86,6 +100,9 @@ def summary_fields(summary) -> dict[str, float]:
         "hh_count": float(len(hh)),
         "anomaly_max": max((float(v) for v in anomaly.values()), default=0.0),
         "decoded_count": float(len(decoded_pairs(summary))),
+        # latency quantile plane: 0.0 when the plane is off or the window
+        # was empty — quantile_shift treats 0 as "no observation"
+        **{p: float(quantiles.get(p, 0.0)) for p in QUANTILE_FIELDS},
     }
 
 
@@ -123,6 +140,9 @@ class AlertRule:
         elif self.kind == "heavy_flow":
             cond = (f"decoded[key] {self.op} {self.threshold:g} "
                     "(invertible plane, exact counts)")
+        elif self.kind == "quantile_shift":
+            cond = (f"{self.field} > {self.factor:g}x mean(last "
+                    f"{self.window}) (latency quantile plane)")
         else:  # anomaly_score
             cond = f"anomaly[mntns] {self.op} {self.threshold:g}"
         return (f"{self.id}: {cond} for {self.for_s:g}s "
@@ -200,6 +220,13 @@ def _parse_rule(raw: object, index: int) -> AlertRule:
     elif kind == "heavy_flow" and field:
         raise RuleError(f"rule {rid!r}: kind 'heavy_flow' evaluates the "
                         f"decoded key counts; remove field={field!r}")
+    elif kind == "quantile_shift":
+        field = field or "p99"
+        if field not in QUANTILE_FIELDS:
+            raise RuleError(
+                f"rule {rid!r}: quantile_shift watches one of "
+                f"{list(QUANTILE_FIELDS)} (the harvest quantile block), "
+                f"got field={field!r}")
 
     denom = raw.get("denom", "")
     if kind == "ratio":
@@ -211,9 +238,11 @@ def _parse_rule(raw: object, index: int) -> AlertRule:
     elif denom:
         raise RuleError(f"rule {rid!r}: 'denom' only applies to kind 'ratio'")
 
-    # cardinality_spike triggers on `factor` x baseline; its threshold is
-    # an optional absolute floor. Every other kind requires one.
-    if "threshold" not in raw and kind != "cardinality_spike":
+    # cardinality_spike / quantile_shift trigger on `factor` x baseline;
+    # their threshold is an optional absolute floor. Every other kind
+    # requires one.
+    if "threshold" not in raw and kind not in ("cardinality_spike",
+                                               "quantile_shift"):
         raise RuleError(f"rule {rid!r}: missing 'threshold'")
     threshold = _num(raw, "threshold", rid, 0.0)
     clear = None

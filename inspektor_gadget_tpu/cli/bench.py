@@ -69,6 +69,12 @@ def add_bench_parser(sub) -> None:
                          "fused path; adds inv_update/inv_decode stages; "
                          "extra.invertible marks the record, series "
                          "unforked)")
+    rp.add_argument("--quantiles", action="store_true",
+                    help="enable the DDSketch latency quantile plane in "
+                         "the measured bundle (fused pipeline only: the "
+                         "value lane rides the staging block; adds a "
+                         "qt_update stage; extra.quantiles marks the "
+                         "record, series unforked)")
     rp.add_argument("--no-ledger", action="store_true",
                     help="print the record without appending it")
     rp.add_argument("-o", "--output", default="json",
@@ -119,7 +125,8 @@ def cmd_bench_run(args) -> int:
             replay=args.replay or None,
             pipeline=args.pipeline,
             chips=args.chips,
-            invertible=args.invertible)
+            invertible=args.invertible,
+            quantiles=args.quantiles)
     except (ValueError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -51,6 +51,10 @@ def add_query_parser(sub) -> None:
                          "--key's)")
     qp.add_argument("--top", type=int, default=10,
                     help="heavy hitters to print")
+    qp.add_argument("--quantiles", action="store_true",
+                    help="print the merged latency quantiles (p50/p90/"
+                         "p99/p99.9) and a log2 ASCII histogram; needs "
+                         "windows sealed with 'quantiles true'")
     qp.add_argument("-o", "--output", default="table",
                     choices=["table", "json"])
     qp.set_defaults(func=cmd_query)
@@ -97,7 +101,7 @@ def cmd_query(args) -> int:
         print(json.dumps(answer.to_dict(), indent=2, default=str))
     else:
         _print_answer(answer, key=key, show_slices=args.slices,
-                      top=args.top)
+                      top=args.top, quantiles=args.quantiles)
     for node, err in answer.errors.items():
         print(f"{node}: error: {err}", file=sys.stderr)
     if answer.windows == 0 and not answer.errors:
@@ -105,8 +109,45 @@ def cmd_query(args) -> int:
     return 1 if answer.errors else 0
 
 
+def render_histogram_log2(hist, *, width: int = 40) -> list[str]:
+    """biolatency-style ASCII render of a log2 histogram: one line per
+    non-empty slot range, `value range  count  distribution` (the
+    reference's print_log2_hist shape). Values are the raw integer
+    domain the value lane captured (ns for latency fields)."""
+    rows = [(k, int(n)) for k, n in enumerate(hist) if int(n) > 0]
+    if not rows:
+        return []
+    lo = min(k for k, _ in rows)
+    hi = max(k for k, _ in rows)
+    peak = max(n for _, n in rows)
+    counts = {k: n for k, n in rows}
+    out = []
+    for k in range(lo, hi + 1):
+        n = counts.get(k, 0)
+        bar = "*" * max(1 if n else 0, round(width * n / peak))
+        out.append(f"  [{2 ** k:>10,}, {2 ** (k + 1):>10,})  "
+                   f"{n:>10,} |{bar:<{width}s}|")
+    return out
+
+
+def _print_quantiles(answer) -> None:
+    qt = answer.quantiles
+    if qt is None:
+        print("quantiles: not available — no window in the range carries "
+              "the quantile plane (run with 'quantiles true')")
+        return
+    print(f"latency quantiles (value-lane units, ddsketch "
+          f"alpha={qt['alpha']:g}):")
+    print(f"  p50={qt['p50']:,.0f} p90={qt['p90']:,.0f} "
+          f"p99={qt['p99']:,.0f} p99.9={qt['p999']:,.0f}")
+    print(f"  total={qt['total']:,} zeros={qt['zeros']:,} "
+          f"underflow={qt['underflow']:,}")
+    for line in render_histogram_log2(answer.histogram or []):
+        print(line)
+
+
 def _print_answer(answer, *, key: str | None, show_slices: bool,
-                  top: int) -> None:
+                  top: int, quantiles: bool = False) -> None:
     nodes = ",".join(answer.nodes) or "local"
     print(f"{answer.windows} window(s) [{nodes}] "
           f"ts {answer.start_ts:.3f} .. {answer.end_ts:.3f}")
@@ -147,6 +188,8 @@ def _print_answer(answer, *, key: str | None, show_slices: bool,
                   "the candidate ring missed:")
             for k32, count, label in answer.decoded_only[:top]:
                 print(f"  {label:<24s}  {count:>12,}")
+    if quantiles:
+        _print_quantiles(answer)
     wanted = ([key] if key else
               (sorted(answer.slices) if show_slices else []))
     for skey in wanted:

@@ -92,6 +92,12 @@ class QueryAnswer:
     decoded_only: list[tuple[int, int, str]] = dataclasses.field(
         default_factory=list)
     inv: dict | None = None
+    # latency quantile plane (ISSUE 16): {p50, p90, p99, p999, zeros,
+    # total, underflow, alpha} read off the merged DDSketch fold, plus
+    # the log2 histogram render input; both None when the range's
+    # windows don't (all) carry the plane with one bucket geometry
+    quantiles: dict | None = None
+    histogram: list[int] | None = None
 
     def compacted_windows(self) -> int:
         """How many folded windows were coarser than native resolution."""
@@ -117,6 +123,8 @@ class QueryAnswer:
                 {"key": f"0x{k:08x}", "count": c, "label": label}
                 for k, c, label in self.decoded_only],
             "inv": self.inv,
+            "quantiles": self.quantiles,
+            "histogram": self.histogram,
             "slices": self.slices,
             "dropped_windows": self.dropped_windows,
             "errors": self.errors,
@@ -206,6 +214,10 @@ def answer_query(windows: Iterable[SealedWindow], *,
         inv_info = {"recovered": dec.recovered,
                     "complete": dec.complete,
                     "residual_events": dec.residual_events}
+    # quantile plane: one read off the merged fold — dd_merge is
+    # lossless, so this equals the read of the union stream
+    qt_out = merged.quantile_answer()
+    hist = merged.histogram_log2()
     slices: dict[str, dict] = {}
     for skey in ([key] if key else sorted(merged.slices)):
         ans = merged.slice_answer(skey)
@@ -240,6 +252,8 @@ def answer_query(windows: Iterable[SealedWindow], *,
         heavy_flows=flows,
         decoded_only=decoded_only,
         inv=inv_info,
+        quantiles=qt_out,
+        histogram=(hist.tolist() if hist is not None else None),
     )
 
 

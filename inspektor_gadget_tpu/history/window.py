@@ -162,6 +162,19 @@ class SealedWindow:
     inv_count: np.ndarray | None = None
     inv_keysum: np.ndarray | None = None
     inv_fpsum: np.ndarray | None = None
+    # -- latency quantile plane (ISSUE 16) --------------------------------
+    # Per-window DDSketch delta: bucket counts plus the zero/total
+    # accounting, all exact integer subtractions of cumulative state.
+    # alpha/min_value pin the bucket boundaries — two windows merge only
+    # when they agree (different alpha = different log base = adding
+    # apples to oranges). None (the default) for plane-off configs, and
+    # absent fields never enter the digest — pre-plane window digests
+    # are byte-identical.
+    qt_counts: np.ndarray | None = None
+    qt_zeros: int = 0
+    qt_total: int = 0
+    qt_alpha: float = 0.01
+    qt_min_value: float = 1.0
 
     @property
     def slice_keys(self) -> list[str]:
@@ -195,6 +208,14 @@ def window_digest(win: SealedWindow) -> str:
             "inv_keysum": arr(win.inv_keysum),
             "inv_fpsum": arr(win.inv_fpsum)}
            if win.inv_count is not None else {}),
+        # quantile plane: same conditional discipline — plane-off
+        # windows digest exactly as before ISSUE 16
+        **({"qt_counts": arr(win.qt_counts),
+            "qt_zeros": int(win.qt_zeros),
+            "qt_total": int(win.qt_total),
+            "qt_alpha": float(win.qt_alpha),
+            "qt_min_value": float(win.qt_min_value)}
+           if win.qt_counts is not None else {}),
         "cms": arr(win.cms),
         "hll": arr(win.hll),
         "ent": arr(win.ent),
@@ -229,6 +250,8 @@ def encode_window(win: SealedWindow) -> tuple[dict, bytes]:
         arrays["inv_count"] = win.inv_count
         arrays["inv_keysum"] = win.inv_keysum
         arrays["inv_fpsum"] = win.inv_fpsum
+    if win.qt_counts is not None:
+        arrays["qt_counts"] = win.qt_counts
     skeys = win.slice_keys
     if skeys:
         arrays["slice_events"] = np.array(
@@ -267,6 +290,15 @@ def encode_window(win: SealedWindow) -> tuple[dict, bytes]:
         header["level"] = int(win.level)
     if win.compacted_from:
         header["compacted_from"] = list(win.compacted_from)
+    if win.qt_counts is not None:
+        # scalar accounting + bucket-boundary identity ride the header
+        # (range listings can report quantile coverage without payload
+        # bytes); plane-off headers carry none of these keys, so the
+        # pre-plane wire bytes are unchanged
+        header["qt_zeros"] = int(win.qt_zeros)
+        header["qt_total"] = int(win.qt_total)
+        header["qt_alpha"] = float(win.qt_alpha)
+        header["qt_min_value"] = float(win.qt_min_value)
     return header, buf.getvalue()
 
 
@@ -309,6 +341,11 @@ def decode_window(header: dict, payload: bytes) -> SealedWindow:
         inv_count=arrays.get("inv_count"),
         inv_keysum=arrays.get("inv_keysum"),
         inv_fpsum=arrays.get("inv_fpsum"),
+        qt_counts=arrays.get("qt_counts"),
+        qt_zeros=int(header.get("qt_zeros", 0)),
+        qt_total=int(header.get("qt_total", 0)),
+        qt_alpha=float(header.get("qt_alpha", 0.01)),
+        qt_min_value=float(header.get("qt_min_value", 1.0)),
     )
 
 
@@ -363,6 +400,53 @@ class MergedWindows:
     inv_count: np.ndarray | None = None
     inv_keysum: np.ndarray | None = None
     inv_fpsum: np.ndarray | None = None
+    # DDSketch fold (bucket-wise add); None when any folded window
+    # lacked the plane or pinned different bucket boundaries
+    # (alpha/min_value) — partial quantile coverage must not read as
+    # total, so the answer drops the plane WITH a skipped note
+    qt_counts: np.ndarray | None = None
+    qt_zeros: int = 0
+    qt_total: int = 0
+    qt_alpha: float = 0.01
+    qt_min_value: float = 1.0
+
+    def quantile(self, q) -> float | np.ndarray:
+        """Value at quantile q over the merged range (<= alpha relative
+        error — dd_merge is lossless, so the merged read is exactly the
+        read of the union stream). NaN when the plane is absent."""
+        if self.qt_counts is None:
+            return float("nan") if np.ndim(q) == 0 else np.full(
+                np.shape(q), np.nan)
+        from ..ops.quantiles import dd_quantile_np
+        out = dd_quantile_np(self.qt_counts, self.qt_zeros, self.qt_total,
+                             q, alpha=self.qt_alpha,
+                             min_value=self.qt_min_value)
+        return float(out) if np.ndim(q) == 0 else out
+
+    def quantile_answer(self) -> dict | None:
+        """The standard quantile block (summary/CLI shape), or None when
+        the plane is absent from the merged range."""
+        if self.qt_counts is None:
+            return None
+        ps = self.quantile([0.50, 0.90, 0.99, 0.999])
+        ps = np.nan_to_num(np.asarray(ps), nan=0.0)
+        return {"p50": float(ps[0]), "p90": float(ps[1]),
+                "p99": float(ps[2]), "p999": float(ps[3]),
+                "zeros": int(self.qt_zeros), "total": int(self.qt_total),
+                "underflow": int(self.qt_counts[0]),
+                "alpha": float(self.qt_alpha)}
+
+    def histogram_log2(self, n_slots: int = 32) -> np.ndarray | None:
+        """biolatency-style log2 re-binning of the merged DDSketch row
+        (ASCII render input): slot k counts values in [2^k, 2^(k+1)) of
+        the lane's raw unit (ns for latency sources). None when the
+        plane is absent."""
+        if self.qt_counts is None:
+            return None
+        from ..ops.quantiles import dd_histogram_log2_np
+        return dd_histogram_log2_np(self.qt_counts, alpha=self.qt_alpha,
+                                    min_value=self.qt_min_value,
+                                    n_slots=n_slots, unit_scale=1.0)
 
     def heavy_flows(self, top: int = 0,
                     min_count: int = 1) -> list[tuple[int, int]]:
@@ -423,6 +507,13 @@ def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
                         events=0, drops=0, cms=None, hll=None, ent=None,
                         candidates={}, slices={}, names={}, skipped=[])
     inv_dropped = False
+    qt_dropped = False
+
+    def qt_matches(win: SealedWindow) -> bool:
+        return (win.qt_counts.shape == out.qt_counts.shape
+                and float(win.qt_alpha) == float(out.qt_alpha)
+                and float(win.qt_min_value) == float(out.qt_min_value))
+
     for win in windows:
         if out.cms is not None and (
                 win.cms.shape != out.cms.shape
@@ -442,6 +533,12 @@ def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
                 out.inv_count = win.inv_count.astype(np.int64).copy()
                 out.inv_keysum = win.inv_keysum.astype(np.uint32).copy()
                 out.inv_fpsum = win.inv_fpsum.astype(np.uint32).copy()
+            if win.qt_counts is not None:
+                out.qt_counts = win.qt_counts.astype(np.int64).copy()
+                out.qt_zeros = int(win.qt_zeros)
+                out.qt_total = int(win.qt_total)
+                out.qt_alpha = float(win.qt_alpha)
+                out.qt_min_value = float(win.qt_min_value)
         else:
             out.cms += win.cms.astype(np.int64)
             np.maximum(out.hll, win.hll, out=out.hll)
@@ -482,6 +579,41 @@ def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
                     "invertible plane present but an earlier window "
                     "lacked it — heavy-flow decode disabled for this "
                     "range")
+        # quantile plane: same total-coverage rule as the invertible
+        # fold — bucket counts add only while EVERY window carries the
+        # plane with the SAME bucket boundaries (alpha/min_value pin the
+        # log base); anything else drops the plane from the answer WITH
+        # a note, because a partial or mixed-base fold would render
+        # confident-looking but wrong percentiles
+        if out.windows > 0:
+            if win.qt_counts is None:
+                if out.qt_counts is not None and not qt_dropped:
+                    qt_dropped = True
+                    out.skipped.append(
+                        f"{win.node}/{win.gadget} window {win.window}: no "
+                        "quantile plane — latency quantiles disabled for "
+                        "this range (partial coverage would lie)")
+                out.qt_counts = None
+            elif out.qt_counts is not None:
+                if not qt_matches(win):
+                    qt_dropped = True
+                    out.skipped.append(
+                        f"{win.node}/{win.gadget} window {win.window}: "
+                        f"quantile geometry {win.qt_counts.shape}/"
+                        f"alpha={win.qt_alpha}/min={win.qt_min_value} "
+                        "differs from the merge base — latency quantiles "
+                        "disabled for this range")
+                    out.qt_counts = None
+                else:
+                    out.qt_counts += win.qt_counts.astype(np.int64)
+                    out.qt_zeros += int(win.qt_zeros)
+                    out.qt_total += int(win.qt_total)
+            elif not qt_dropped:
+                qt_dropped = True
+                out.skipped.append(
+                    f"{win.node}/{win.gadget} window {win.window}: "
+                    "quantile plane present but an earlier window lacked "
+                    "it — latency quantiles disabled for this range")
         out.windows += 1
         if win.node and win.node not in out.nodes:
             out.nodes.append(win.node)
@@ -573,6 +705,16 @@ def merged_to_sealed(merged: MergedWindows, *, gadget: str, node: str,
                     else None),
         inv_fpsum=(merged.inv_fpsum if merged.inv_fpsum is not None
                    else None),
+        # the quantile fold rides the same int64 write path: a
+        # super-window's bucket counts can exceed int32 over an
+        # unbounded range; merge_windows folds mixed int32/int64 in
+        # int64 already
+        qt_counts=(merged.qt_counts if merged.qt_counts is not None
+                   else None),
+        qt_zeros=int(merged.qt_zeros),
+        qt_total=int(merged.qt_total),
+        qt_alpha=float(merged.qt_alpha),
+        qt_min_value=float(merged.qt_min_value),
     )
     win.digest = window_digest(win)
     return win

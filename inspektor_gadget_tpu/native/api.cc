@@ -405,6 +405,45 @@ int64_t ig_source_pop_folded(uint64_t h, int64_t n, uint32_t* keys,
   return (int64_t)got;
 }
 
+// Value-lane variant of ig_source_pop_folded (quantile plane): one more
+// uint32 out column carrying the per-event magnitude — latency ns or byte
+// count, whatever the kind keeps in aux1 (fsslower/file-rw latency,
+// block-io latency, tcp interval bytes). Kinds without a magnitude write
+// 0, which the DDSketch accounts in its zero bucket instead of a
+// positive latency bin. Saturating cast: aux1 past 2^32-1 (a ~4.3 s
+// latency) clamps to UINT32_MAX — still inside the sketch's top bucket
+// span, so the quantile read degrades gracefully instead of wrapping.
+int64_t ig_source_pop_folded2(uint64_t h, int64_t n, uint32_t* keys,
+                              uint32_t* weights, uint32_t* mntns,
+                              uint32_t* values) {
+  Source* s = lookup(h);
+  if (!s || n <= 0 || !keys) return -1;
+  static thread_local std::vector<Event> tmp;
+  tmp.resize((size_t)n);
+  size_t got = s->pop(tmp.data(), (size_t)n);
+  for (size_t i = 0; i < got; i++) {
+    const Event& e = tmp[i];
+    keys[i] = (uint32_t)((e.key_hash >> 32) ^ (e.key_hash & 0xFFFFFFFFull));
+    if (weights) weights[i] = 1u;
+    if (mntns)
+      mntns[i] = (uint32_t)((e.mntns >> 32) ^ (e.mntns & 0xFFFFFFFFull));
+    if (values) {
+      switch (e.kind) {
+        case EV_FSSLOWER:
+        case EV_FILE_RW:
+        case EV_BLOCK_IO:
+        case EV_TCP_BYTES:
+          values[i] = (e.aux1 > 0xFFFFFFFFull) ? 0xFFFFFFFFu
+                                               : (uint32_t)e.aux1;
+          break;
+        default:
+          values[i] = 0u;
+      }
+    }
+  }
+  return (int64_t)got;
+}
+
 uint64_t ig_source_drops(uint64_t h) {
   Source* s = lookup(h);
   return s ? s->drops() : 0;

@@ -46,7 +46,7 @@ from ..ops.sketches import (bundle_digest_jit, bundle_ingest_jit,
 from ..ops.window import wcms_advance, wcms_init, wcms_query, wcms_update
 from ..params import ParamDesc, ParamDescs, ParamError, Params, TypeHint
 from ..params.validators import validate_int_range
-from ..sources.batch import EventBatch, FoldedBatch
+from ..sources.batch import BATCH_COLUMNS, EventBatch, FoldedBatch
 from ..sources.staging import H2DStager, PinnedBufferPool
 from ..telemetry import counter, histogram
 from ..telemetry.tracing import TRACER, device_annotation
@@ -89,6 +89,18 @@ def _validate_priority_classes(value: str) -> None:
     inv-log2-buckets and runs at instantiation)."""
     parse_priority_classes(value)
 
+
+def _validate_quantile_alpha(value: str) -> None:
+    """DDSketch relative-error target: a float in (0, 0.3] — beyond that
+    the bucket span collapses to a handful of buckets and every read is
+    the same midpoint."""
+    try:
+        v = float(value)
+    except ValueError:
+        raise ValueError(f"{value!r} is not a float") from None
+    if not (0.0 < v <= 0.3):
+        raise ValueError(f"quantile-alpha must be in (0, 0.3], got {v}")
+
 # device-plane telemetry (batch-grain; the histograms time dispatch-side —
 # device completion is async and surfaces in the next blocking read)
 _tm_events = counter("ig_tpusketch_events_total",
@@ -118,6 +130,17 @@ _tm_cand_overflow = counter(
     "runs whose top-k candidate population exceeded k (the harvest's "
     "heavy-hitter re-rank became approximate; summaries carry approx=True)",
     ("gadget",))
+# latency quantile plane (ISSUE 16): events absorbed into the DDSketch
+# row vs events whose value lane carried no magnitude (source without a
+# value column, or a genuinely zero latency) — the denominator a reader
+# needs to judge how much of a pX is the zero bucket
+_tm_qt_events = counter(
+    "ig_sketch_quantile_events_total",
+    "events absorbed into the DDSketch quantile plane", ("gadget",))
+_tm_qt_zero = counter(
+    "ig_sketch_quantile_zero_total",
+    "quantile-plane events whose value lane was zero (no magnitude — "
+    "they land in the sketch's zero bucket, not a log bucket)")
 
 _ckpt_log = get_logger("ig-tpu.tpusketch")
 
@@ -194,6 +217,10 @@ class SketchSummary:
     inv: dict | None = None        # decode accounting {recovered, complete,
     #                                residual_events, capacity}
     classes: dict[str, dict] | None = None  # priority class → decode answer
+    # latency quantile plane (ISSUE 16): DDSketch read of the merged
+    # state — {p50, p90, p99, p999, zeros, total, underflow, alpha};
+    # None when the plane is off (pre-plane consumers see no new field)
+    quantiles: dict | None = None
     # flat numeric access for detector rules lives in ONE place:
     # alerts.rules.summary_fields (handles this dataclass and the
     # wire-decoded dict shape alike)
@@ -318,6 +345,28 @@ class TpuSketch(Operator):
                                   "budget so hot tenants keep decode "
                                   "fidelity when the whole stream "
                                   "overflows it"),
+            # latency quantile plane (ISSUE 16): a DDSketch row rides the
+            # fused kernel as one more grid plane; harvest answers
+            # p50/p90/p99/p99.9 with <= alpha relative error, merges by
+            # bucket-wise add (windows, pushdown, collective harvest)
+            ParamDesc(key="quantiles", default="false",
+                      type_hint=TypeHint.BOOL,
+                      description="add the DDSketch latency quantile "
+                                  "plane: per-event magnitudes (latency "
+                                  "ns / bytes) bucket into one more fused "
+                                  "grid plane; harvests carry "
+                                  "p50/p90/p99/p99.9"),
+            ParamDesc(key="quantile-alpha", default="0.01",
+                      validator=_validate_quantile_alpha,
+                      description="DDSketch relative-error target: every "
+                                  "quantile read is within alpha of the "
+                                  "true value (0.01 = 1%)"),
+            ParamDesc(key="quantile-field", default="aux1",
+                      description="wire column feeding the value lane on "
+                                  "the EventBatch path (aux1 carries "
+                                  "latency ns / byte counts for the "
+                                  "value-bearing kinds; folded batches "
+                                  "carry their own lane)"),
             # multi-chip sharded ingest (ISSUE 14): one fused bundle
             # replica per chip, batches round-robined onto per-device
             # lanes, psum/pmax collective merge at harvest only
@@ -422,6 +471,7 @@ class TpuSketchInstance(OperatorInstance):
         self._m_h2d = _tm_h2d.labels(gadget=g)
         self._m_update = _tm_update.labels(gadget=g)
         self._m_harvest_s = _tm_harvest_s.labels(gadget=g)
+        self._m_qt_events = _tm_qt_events.labels(gadget=g)
         # -- invertible heavy-key plane + priority classes (ISSUE 15) ----
         # All validation answers a typed ParamError HERE, before the
         # first batch: classes without the plane, and class geometries
@@ -450,6 +500,31 @@ class TpuSketchInstance(OperatorInstance):
             self._inv_classes = [
                 (c, inv_init(self._inv_rows, c.log2_buckets)) for c in cls]
         self._overflow_counted = False
+        # -- latency quantile plane (ISSUE 16) ----------------------------
+        # Same loud-validation discipline: every quantile misconfig is a
+        # typed ParamError before the first batch. quantile-alpha's range
+        # is the param validator's job; the cross-param rules live here.
+        self._qt_on = (p.get("quantiles").as_bool()
+                       if "quantiles" in p else False)
+        self._qt_alpha = (float(p.get("quantile-alpha").as_string())
+                          if "quantile-alpha" in p else 0.01)
+        self._qt_field = (p.get("quantile-field").as_string()
+                          if "quantile-field" in p else "aux1")
+        self._qt_minv = 1.0   # value lane is integer ns/bytes: 0 is the
+        #                       zero bucket, 1 the smallest magnitude
+        if not self._qt_on:
+            if self._qt_alpha != 0.01:
+                raise ParamError(
+                    "param 'quantile-alpha': needs 'quantiles true' — "
+                    "the error target configures the DDSketch plane")
+            if self._qt_field != "aux1":
+                raise ParamError(
+                    "param 'quantile-field': needs 'quantiles true' — "
+                    "the value lane only exists with the quantile plane")
+        elif self._qt_field not in BATCH_COLUMNS:
+            raise ParamError(
+                f"param 'quantile-field': {self._qt_field!r} is not a "
+                f"wire column (one of {', '.join(BATCH_COLUMNS)})")
         self.bundle = bundle_init(
             depth=p.get("depth").as_int(),
             log2_width=p.get("log2-width").as_int(),
@@ -458,6 +533,9 @@ class TpuSketchInstance(OperatorInstance):
             k=p.get("topk").as_int(),
             inv_rows=self._inv_rows if self._inv_on else 0,
             inv_log2_buckets=self._inv_lb,
+            quantiles=self._qt_on,
+            quantile_alpha=self._qt_alpha,
+            quantile_min_value=self._qt_minv,
         )
         self.anomaly_on = p.get("anomaly").as_bool()
         self.anomaly_model = (p.get("anomaly-model").as_string()
@@ -605,6 +683,7 @@ class TpuSketchInstance(OperatorInstance):
             self._win_drops0 = 0.0
             self._win_ent0 = np.asarray(self.bundle.entropy.counts).copy()
             self._win_inv0 = self._inv_host(self.bundle)
+            self._win_qt0 = self._qt_host(self.bundle)
             self._win_slices: dict[str, Any] = {}
             self._win_slices_dropped_keys: set[str] = set()
             from ..history import HISTORY
@@ -652,6 +731,7 @@ class TpuSketchInstance(OperatorInstance):
             self._win_drops0 = float(self.bundle.drops)
             self._win_ent0 = np.asarray(self.bundle.entropy.counts).copy()
             self._win_inv0 = self._inv_host(self.bundle)
+            self._win_qt0 = self._qt_host(self.bundle)
         with _live_mu:
             _live[ctx.run_id] = self
 
@@ -674,6 +754,44 @@ class TpuSketchInstance(OperatorInstance):
         return (np.asarray(b.inv.count).astype(np.int64).copy(),
                 np.asarray(b.inv.keysum).copy(),
                 np.asarray(b.inv.fpsum).copy())
+
+    # -- latency quantile plane helpers (ISSUE 16) --------------------------
+
+    @staticmethod
+    def _qt_host(b) -> tuple | None:
+        """Host snapshot of the bundle's DDSketch lanes (counts int64,
+        zeros, total) — window-open baseline for seal deltas and the
+        harvest's quantile read. Caller must hold _bundle_mu when `b` is
+        the live bundle (the next update donates its buffers)."""
+        if b.quantiles is None:
+            return None
+        return (np.asarray(b.quantiles.counts).astype(np.int64).copy(),
+                int(b.quantiles.zeros), int(b.quantiles.total))
+
+    def _qt_value_lane(self, batch: EventBatch, block: np.ndarray,
+                       n: int) -> np.ndarray:
+        """Fill the block's value lane (row 4) from the configured wire
+        column: saturate-cast to uint32 so magnitudes past 2^32-1 (~4.3s
+        of latency) clamp into the top bucket span instead of wrapping
+        back into the small buckets. Pad slots carry 0 (weight 0 anyway)."""
+        vals = block[4]
+        raw = batch.cols[self._qt_field][:n].astype(np.uint64, copy=False)
+        vals[:n] = np.minimum(raw, np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        vals[n:] = 0
+        return vals
+
+    def _qt_count(self, vals_np: np.ndarray | None, n: int) -> None:
+        """Quantile-plane telemetry for one absorbed batch: every event
+        enters the plane; those without a magnitude land in the zero
+        bucket and are counted separately (gauge-discipline: both are
+        monotonic counters)."""
+        if not self._qt_on:
+            return
+        self._m_qt_events.inc(n)
+        z = (n if vals_np is None
+             else int(n - np.count_nonzero(vals_np[:n])))
+        if z > 0:
+            _tm_qt_zero.inc(z)
 
     @staticmethod
     def _padded_mntns(batch: EventBatch, n: int, pad: int) -> np.ndarray:
@@ -720,8 +838,11 @@ class TpuSketchInstance(OperatorInstance):
         if self._pool is None or self._pool.capacity != pad:
             if self._stager is not None:
                 self._stager.drain()
-            # 4 lanes: up to three distinct key columns + the weights lane
-            self._pool = PinnedBufferPool(pad, lanes=4,
+            # 4 lanes: up to three distinct key columns + the weights
+            # lane; the quantile plane adds a 5th (the value lane) —
+            # plane-off runs keep the exact 4-lane pool
+            self._pool = PinnedBufferPool(pad,
+                                          lanes=5 if self._qt_on else 4,
                                           max_free=self._h2d_depth + 2)
             self._stager = H2DStager(self._pool, depth=self._h2d_depth)
         self._pad = max(self._pad, pad)
@@ -761,7 +882,7 @@ class TpuSketchInstance(OperatorInstance):
                 st.drain()
             devices = list(self._mesh.devices.reshape(-1))
             self._lane_pools = [
-                PinnedBufferPool(pad, lanes=4,
+                PinnedBufferPool(pad, lanes=5 if self._qt_on else 4,
                                  max_free=self._h2d_depth + 2, lane=k)
                 for k in range(self._chips)]
             self._lane_stagers = [
@@ -783,16 +904,25 @@ class TpuSketchInstance(OperatorInstance):
 
     def _shard_absorb_locked(self, hh_d, distinct_d, dist_d, w_d,
                              new_drops: float, window_tokens: list,
-                             slot: int) -> None:
+                             slot: int, values_d=None) -> None:
         """Park one staged batch on its lane (the staged arrays already
         live on that lane's chip; `slot` — captured at stage time —
         names the stager slot to fence at dispatch) and advance the
         round-robin counter; dispatch ONE sharded step when every lane
-        holds a batch. Caller holds _bundle_mu (pending state and the
-        sharded bundle move together)."""
+        holds a batch. Under the quantile plane each round carries a 5th
+        value-lane array; a batch without one (folded source with no
+        magnitude column) rides the lane's cached zero array — every
+        event lands in the zero bucket, totals stay honest. Caller holds
+        _bundle_mu (pending state and the sharded bundle move
+        together)."""
         lane = self._next_lane
+        if self._qt_on and values_d is None:
+            values_d = self._lane_zeros[lane]
+        arrays = (hh_d, distinct_d, dist_d, w_d)
+        if self._qt_on:
+            arrays = arrays + (values_d,)
         self._pending[lane] = {
-            "arrays": (hh_d, distinct_d, dist_d, w_d),
+            "arrays": arrays,
             "slot": slot,
             "drops": max(new_drops, 0.0),
             "fences": list(window_tokens),
@@ -820,11 +950,12 @@ class TpuSketchInstance(OperatorInstance):
 
         from ..parallel.mesh import NODE_AXIS
         pad = self._lane_pools[0].capacity
+        n_arr = 5 if self._qt_on else 4
         for lane in range(self._chips):
             if lane in self._pending:
                 continue
             z = self._lane_zeros[lane]
-            self._pending[lane] = {"arrays": (z, z, z, z), "slot": None,
+            self._pending[lane] = {"arrays": (z,) * n_arr, "slot": None,
                                    "drops": 0.0, "fences": []}
         sh = NamedSharding(self._mesh, P(NODE_AXIS))
         by_lane = [self._pending[lane] for lane in range(self._chips)]
@@ -841,8 +972,15 @@ class TpuSketchInstance(OperatorInstance):
             [jax.device_put(np.asarray([p["drops"]], np.float32),
                             devices[i])
              for i, p in enumerate(by_lane)])
-        self._sharded, tok = self._ingest_sharded(
-            self._sharded, hh, distinct, dist, w, drops)
+        if self._qt_on:
+            # the 5th lane array (per-event magnitudes) rides the same
+            # sharded step; the sharded ingest maker added the values
+            # argument when the bundle carries the plane
+            self._sharded, tok = self._ingest_sharded(
+                self._sharded, hh, distinct, dist, w, drops, global_of(4))
+        else:
+            self._sharded, tok = self._ingest_sharded(
+                self._sharded, hh, distinct, dist, w, drops)
         for lane, p in enumerate(by_lane):
             # the global token waits for every lane's consumer (plus the
             # lane's window-plane steps) before its block recycles;
@@ -902,6 +1040,8 @@ class TpuSketchInstance(OperatorInstance):
             w = block[3]
             w[:n] = 1
             w[n:] = 0
+            vals = (self._qt_value_lane(batch, block, n)
+                    if self._qt_on else None)
             new_drops = batch.drops - self._drops_seen
             self._drops_seen = batch.drops
             # ONE async device put per distinct lane (shared columns stage
@@ -909,13 +1049,16 @@ class TpuSketchInstance(OperatorInstance):
             # the previous one — the block returns to the pool only after
             # the consumer fence below completes
             uniq = list(lanes.values())
-            staged = stager.stage(block, uniq + [w])
+            staged = stager.stage(
+                block, uniq + [w] + ([vals] if vals is not None else []))
             staged_slot = stager.last_slot
-            by_col = dict(zip(lanes.keys(), staged[:-1]))
+            nk = len(lanes)
+            by_col = dict(zip(lanes.keys(), staged[:nk]))
             hh_d = by_col[self.hh_col]
             distinct_d = by_col[self.distinct_col]
             dist_d = by_col[self.dist_col]
-            w_d = staged[-1]
+            w_d = staged[nk]
+            v_d = staged[nk + 1] if vals is not None else None
         t1 = time.perf_counter()
         with self._span("tpusketch/update", events=n), \
                 device_annotation("ig:tpusketch_update"):
@@ -944,13 +1087,19 @@ class TpuSketchInstance(OperatorInstance):
                     self._shard_absorb_locked(
                         hh_d, distinct_d, dist_d, w_d,
                         float(max(new_drops, 0)), window_tokens,
-                        staged_slot)
+                        staged_slot, values_d=v_d)
             else:
                 with self._bundle_mu:
-                    self.bundle, tok = _ingest_jit(
-                        self.bundle, hh_d, distinct_d, dist_d, w_d,
-                        jnp.float32(max(new_drops, 0)),
-                    )
+                    if self._qt_on:
+                        self.bundle, tok = _ingest_jit(
+                            self.bundle, hh_d, distinct_d, dist_d, w_d,
+                            jnp.float32(max(new_drops, 0)), v_d,
+                        )
+                    else:
+                        self.bundle, tok = _ingest_jit(
+                            self.bundle, hh_d, distinct_d, dist_d, w_d,
+                            jnp.float32(max(new_drops, 0)),
+                        )
                 fence = [tok]
                 if self._hist_on:
                     # window-plane device steps ride the same staged
@@ -984,6 +1133,7 @@ class TpuSketchInstance(OperatorInstance):
         self._m_update.observe(t2 - t1)
         self._m_events.inc(n)
         self._m_steps.inc()
+        self._qt_count(vals, n)
         if new_drops > 0:
             self._m_drops.inc(new_drops)
         self._stats.steps += 1
@@ -1023,12 +1173,23 @@ class TpuSketchInstance(OperatorInstance):
             _pool, stager = (self._lane_staging(fb.capacity)
                              if self._shard_on
                              else self._staging_for(fb.capacity))
+            fvals = fb.values if self._qt_on else None
             if n < fb.capacity:
                 fb.keys[n:] = 0
                 fb.weights[n:] = 0
+                if fvals is not None:
+                    fvals[n:] = 0
             new_drops = fb.drops - self._drops_seen
             self._drops_seen = fb.drops
-            k_d, w_d = stager.stage(fb.lanes, (fb.keys, fb.weights))
+            if fvals is not None:
+                # pop_folded2 filled row 3 with per-event magnitudes:
+                # the value lane stages with the keys/weights in the
+                # same pinned block (one more view, zero extra copies)
+                k_d, w_d, v_d = stager.stage(
+                    fb.lanes, (fb.keys, fb.weights, fvals))
+            else:
+                k_d, w_d = stager.stage(fb.lanes, (fb.keys, fb.weights))
+                v_d = None
             staged_slot = stager.last_slot
         t1 = time.perf_counter()
         with self._span("tpusketch/update", events=n), \
@@ -1053,12 +1214,20 @@ class TpuSketchInstance(OperatorInstance):
                 with self._bundle_mu:
                     self._shard_absorb_locked(
                         k_d, k_d, k_d, w_d, float(max(new_drops, 0)),
-                        window_tokens, staged_slot)
+                        window_tokens, staged_slot, values_d=v_d)
             else:
                 with self._bundle_mu:
-                    self.bundle, tok = _ingest_jit(
-                        self.bundle, k_d, k_d, k_d, w_d,
-                        jnp.float32(max(new_drops, 0)))
+                    if self._qt_on:
+                        # v_d may be None (folded source with no value
+                        # lane): the ingest step zero-fills — every
+                        # event lands in the zero bucket, totals honest
+                        self.bundle, tok = _ingest_jit(
+                            self.bundle, k_d, k_d, k_d, w_d,
+                            jnp.float32(max(new_drops, 0)), v_d)
+                    else:
+                        self.bundle, tok = _ingest_jit(
+                            self.bundle, k_d, k_d, k_d, w_d,
+                            jnp.float32(max(new_drops, 0)))
                 fence = [tok]
                 if self._hist_on:
                     # same window-plane steps as enrich_batch: the
@@ -1082,6 +1251,7 @@ class TpuSketchInstance(OperatorInstance):
         self._m_update.observe(t2 - t1)
         self._m_events.inc(n)
         self._m_steps.inc()
+        self._qt_count(fvals, n)
         if new_drops > 0:
             self._m_drops.inc(new_drops)
         self._stats.steps += 1
@@ -1096,8 +1266,10 @@ class TpuSketchInstance(OperatorInstance):
             self.harvest()
 
     def folded_block(self) -> np.ndarray:
-        """A pinned (4, pad) staging block for pop_folded (rows 0..2 are
-        the keys/weights/mntns lanes; row 3 is unused padding). Under
+        """A pinned (4+, pad) staging block for pop_folded (rows 0..2 are
+        the keys/weights/mntns lanes; row 3 is scratch unless the caller
+        pops through `pop_folded(block, with_values=True)`, which fills
+        it with per-event magnitudes for the quantile plane). Under
         shard-ingest the block comes from the pool of the lane the next
         ingest_folded will land on, so it recycles through that lane's
         ring."""
@@ -1261,6 +1433,7 @@ class TpuSketchInstance(OperatorInstance):
             ent_now = np.asarray(b.entropy.counts).copy()
             cand = np.asarray(b.topk.keys).copy()
             inv_now = self._inv_host(b)
+            qt_now = self._qt_host(b)
         win_events = int(events - self._win_events0)
         if win_events <= 0 and not self._win_slices:
             self._win_start = end
@@ -1286,6 +1459,18 @@ class TpuSketchInstance(OperatorInstance):
                 "inv_keysum": inv_now[1] - self._win_inv0[1],
                 "inv_fpsum": inv_now[2] - self._win_inv0[2],
             }
+        # DDSketch quantile plane rides the same cumulative-delta recipe:
+        # bucket counts / zeros / total are pure integer adds, so the
+        # window's latency distribution is an exact subtraction — merged
+        # windows fold via dd_merge like merged live state
+        if qt_now is not None and self._win_qt0 is not None:
+            inv_kw.update(
+                qt_counts=(qt_now[0] - self._win_qt0[0]).astype(np.int32),
+                qt_zeros=int(qt_now[1] - self._win_qt0[1]),
+                qt_total=int(qt_now[2] - self._win_qt0[2]),
+                qt_alpha=float(self._qt_alpha),
+                qt_min_value=float(self._qt_minv),
+            )
         win = SealedWindow(
             gadget=self._hist_gadget,
             node=self.ctx.extra.get("node", "") or "",
@@ -1351,6 +1536,7 @@ class TpuSketchInstance(OperatorInstance):
         self._win_drops0 = drops
         self._win_ent0 = ent_now
         self._win_inv0 = inv_now
+        self._win_qt0 = qt_now
         self._win_slices = {}
         self._win_slices_dropped_keys = set()
 
@@ -1373,6 +1559,7 @@ class TpuSketchInstance(OperatorInstance):
         # dispatched computation pins its inputs against later donation);
         # the numpy finisher runs outside it.
         inv_dev = None
+        qt_now = None
         with self._bundle_mu:
             merged = self._merged_locked()
             digest = bundle_digest_jit(merged)
@@ -1380,6 +1567,11 @@ class TpuSketchInstance(OperatorInstance):
                 from ..ops.invertible import inv_decode_device
                 cap = min(4096, inv_capacity(self._inv_rows, self._inv_lb))
                 inv_dev = inv_decode_device(merged.inv, sweeps=2, cap=cap)
+            if self._qt_on and merged.quantiles is not None:
+                # snapshot under the lock (single-chip: the next update
+                # donates these buffers); the quantile math runs on the
+                # host copies outside it
+                qt_now = self._qt_host(merged)
         events_f, drops_f, distinct, entropy_bits, approx, keys, counts = (
             decode_digest(digest))
         if approx and not self._overflow_counted:
@@ -1434,6 +1626,25 @@ class TpuSketchInstance(OperatorInstance):
                         "complete": cdec.complete,
                         "residual_events": cdec.residual_events,
                     }
+        # latency quantile read: four ranks off the merged DDSketch row,
+        # plus the accounting a reader needs to judge them (zeros = no
+        # magnitude; underflow = clamped below min_value into bucket 0)
+        qt_out = None
+        if qt_now is not None:
+            from ..ops.quantiles import dd_quantile_np
+            c, z, t = qt_now
+            if t > 0:
+                ps = dd_quantile_np(c, z, t, [0.50, 0.90, 0.99, 0.999],
+                                    alpha=self._qt_alpha,
+                                    min_value=self._qt_minv)
+            else:
+                ps = np.zeros(4)   # empty sketch: 0.0, never NaN on wire
+            qt_out = {
+                "p50": float(ps[0]), "p90": float(ps[1]),
+                "p99": float(ps[2]), "p999": float(ps[3]),
+                "zeros": int(z), "total": int(t),
+                "underflow": int(c[0]), "alpha": float(self._qt_alpha),
+            }
         # late enrichment: names resolve HERE (once per tick, from the
         # sample ring), not in the per-batch ingest path
         self._resolve_late([k for k, _ in hh[:32]])
@@ -1467,6 +1678,7 @@ class TpuSketchInstance(OperatorInstance):
             decoded_only=decoded_only,
             inv=inv_info,
             classes=classes_out,
+            quantiles=qt_out,
         )
         # read the consumer LIVE from ctx.extra (falling back to the one
         # captured at init): the alerts operator chains its engine into

@@ -23,7 +23,7 @@ from .invertible import (
 )
 from .quantiles import (
     DDSketch, dd_init, dd_update, dd_quantile, dd_merge, dd_psum,
-    dd_histogram_log2,
+    dd_histogram_log2, dd_quantile_np, dd_histogram_log2_np,
 )
 from .sketches import (
     SketchBundle, bundle_init, bundle_update, bundle_update_fused,
@@ -39,7 +39,8 @@ __all__ = [
     "InvSketch", "InvDecode", "inv_init", "inv_update", "inv_merge",
     "inv_psum", "inv_decode", "inv_capacity",
     "DDSketch", "dd_init", "dd_update", "dd_quantile", "dd_merge",
-    "dd_psum", "dd_histogram_log2",
+    "dd_psum", "dd_histogram_log2", "dd_quantile_np",
+    "dd_histogram_log2_np",
     "SketchBundle", "bundle_init", "bundle_update", "bundle_update_fused",
     "bundle_merge", "fused_supported",
 ]

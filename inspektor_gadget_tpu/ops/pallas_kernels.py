@@ -17,6 +17,7 @@ Kernel contract: fixed shapes, f32 accumulation (exact for batch counts
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -96,16 +97,19 @@ def xla_histogram(keys: jnp.ndarray, weights: jnp.ndarray, *,
 
 
 # ---------------------------------------------------------------------------
-# Fused bundle_update kernel (ISSUE 10 tentpole; invertible planes ISSUE 15).
+# Fused bundle_update kernel (ISSUE 10 tentpole; invertible planes ISSUE
+# 15; DDSketch quantile plane ISSUE 16).
 #
 # SketchLib / NitroSketch observation: the order-of-magnitude win is ONE
 # pass over the staged batch updating every sketch plane, instead of one
 # dispatched op per sketch. This kernel folds the three histogram-shaped
 # planes (depth count-min rows + the entropy buckets), the HLL
 # register-max plane, and (when configured) the invertible sketch's
-# count/key-sum/fingerprint lanes into a single pallas_call:
+# count/key-sum/fingerprint lanes and the DDSketch latency-quantile row
+# into a single pallas_call:
 #
-#   grid = (n_planes, Wmax/W_TILE), n_planes = depth + 2 + 3*inv_rows
+#   grid = (n_planes, Wmax/W_TILE),
+#   n_planes = depth + 2 + 3*inv_rows + (1 if quantiles)
 #   plane 0..depth-1   CMS row d:  h = fmix32(hh * mult_d + salt_d)
 #   plane depth        entropy:    h = fmix32(dist * mult_0)
 #   plane depth+1      HLL:        h = fmix32(distinct); value = rank,
@@ -114,6 +118,11 @@ def xla_histogram(keys: jnp.ndarray, weights: jnp.ndarray, *,
 #                                  fpsum}: uint32 accumulation (wraps
 #                                  mod 2^32 — the invertible algebra),
 #                                  bitcast to f32 bits for the output
+#   last plane         quantiles:  bucket = ceil(log_gamma(value)) (no
+#                                  hashing — DDSketch's log-spaced bins),
+#                                  one-hot histogram of the value lane;
+#                                  zero-valued rows weigh 0 (they land in
+#                                  the host-side zero bucket)
 #
 # Every plane is padded to the widest plane's tile count so the grid and
 # index maps stay trivial; tiles past a narrow plane's real width can
@@ -130,10 +139,17 @@ def xla_histogram(keys: jnp.ndarray, weights: jnp.ndarray, *,
 # ---------------------------------------------------------------------------
 
 
-def _fused_kernel(hh_ref, distinct_ref, dist_ref, w_ref, out_ref, *,
+def _fused_kernel(hh_ref, distinct_ref, dist_ref, w_ref, *rest,
                   depth: int, log2_width: int, ent_log2_width: int,
                   hll_p: int, inv_rows: int, inv_log2_buckets: int,
-                  n_chunks: int):
+                  qt_buckets: int, qt_inv_log_gamma: float,
+                  qt_offset: float, qt_min_value: float, n_chunks: int):
+    # the quantile plane adds a 5th input ref (the value lane); pallas
+    # passes output refs after input refs, so unpack positionally
+    if qt_buckets:
+        values_ref, out_ref = rest
+    else:
+        (out_ref,) = rest
     plane = pl.program_id(0)
     tile = pl.program_id(1)
 
@@ -190,6 +206,34 @@ def _fused_kernel(hh_ref, distinct_ref, dist_ref, w_ref, out_ref, *,
     def run_hist():
         return jax.lax.fori_loop(0, n_chunks, hist_body, zero)
 
+    def base_dispatch():
+        return jax.lax.cond(plane == depth + 1, run_hll, run_hist)
+
+    if qt_buckets:
+        # DDSketch row: same one-hot MXU histogram as the CMS/entropy
+        # planes, but the bucket index is the log-gamma bin of the VALUE
+        # lane (no hashing) — the exact ops.quantiles._bucket_index
+        # expression, constants folded in as immediates so interpret-mode
+        # parity with the reference scatter path is bit-identical.
+        # Zero-valued rows weigh 0 here; the wrapper accounts them in the
+        # sketch's zero bucket (dd_update's is_zero term).
+        def qt_body(c, acc):
+            vals = values_ref[c, :].astype(jnp.float32)
+            wk = w_ref[c, :]
+            v = jnp.maximum(vals, qt_min_value)
+            idx = jnp.ceil(jnp.log(v) * qt_inv_log_gamma - qt_offset)
+            idx = jnp.clip(idx, 0, qt_buckets - 1).astype(jnp.int32)
+            wpos = jnp.where(vals > 0, wk, 0.0)
+            local = idx - tile * W_TILE
+            onehot = (local[:, None] == iota).astype(jnp.float32)
+            return acc + jnp.dot(wpos[None, :], onehot,
+                                 preferred_element_type=jnp.float32)
+
+        def run_qt():
+            return jax.lax.fori_loop(0, n_chunks, qt_body, zero)
+
+        qt_plane = depth + 2 + 3 * inv_rows
+
     if inv_rows:
         # invertible planes: bucket-hash parameters per ROW (3 planes
         # share a row), the lane kind (count/keysum/fpsum) selected by
@@ -231,65 +275,95 @@ def _fused_kernel(hh_ref, distinct_ref, dist_ref, w_ref, out_ref, *,
                 0, n_chunks, inv_body, jnp.zeros((1, W_TILE), jnp.uint32))
             return jax.lax.bitcast_convert_type(acc_u, jnp.float32)
 
-        acc = jax.lax.cond(
-            plane >= inv_base, run_inv,
-            lambda: jax.lax.cond(plane == depth + 1, run_hll, run_hist))
+        def inv_dispatch():
+            return jax.lax.cond(plane >= inv_base, run_inv, base_dispatch)
+
+        # the quantile plane sits LAST (id >= inv_base), so it must win
+        # the dispatch before the `plane >= inv_base` invertible test
+        acc = (jax.lax.cond(plane == qt_plane, run_qt, inv_dispatch)
+               if qt_buckets else inv_dispatch())
+    elif qt_buckets:
+        acc = jax.lax.cond(plane == qt_plane, run_qt, base_dispatch)
     else:
-        acc = jax.lax.cond(plane == depth + 1, run_hll, run_hist)
+        acc = base_dispatch()
     out_ref[0, 0, :, :] = acc.reshape(8, 128)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "depth", "log2_width", "ent_log2_width", "hll_p", "inv_rows",
-    "inv_log2_buckets", "interpret"))
+    "inv_log2_buckets", "qt_buckets", "qt_alpha", "qt_min_value",
+    "interpret"))
 def fused_sketch_planes(hh_keys: jnp.ndarray, distinct_keys: jnp.ndarray,
-                        dist_keys: jnp.ndarray, weights: jnp.ndarray, *,
+                        dist_keys: jnp.ndarray, weights: jnp.ndarray,
+                        values: jnp.ndarray | None = None, *,
                         depth: int, log2_width: int, ent_log2_width: int,
                         hll_p: int, inv_rows: int = 0,
-                        inv_log2_buckets: int = 0, interpret: bool = False
+                        inv_log2_buckets: int = 0, qt_buckets: int = 0,
+                        qt_alpha: float = 0.01, qt_min_value: float = 1.0,
+                        interpret: bool = False
                         ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
-                                   jnp.ndarray | None]:
+                                   jnp.ndarray | None, jnp.ndarray | None]:
     """One fused pass over the staged batch → per-plane state deltas:
     (cms_delta (depth, W) f32, ent_delta (2**ent_log2_width,) f32,
     hll_batch_ranks (2**hll_p,) f32, inv_delta (inv_rows, 3,
-    2**inv_log2_buckets) uint32 or None). The invertible deltas come
-    back already bitcast to uint32 with lanes ordered (count, keysum,
-    fpsum) per row. n must be a multiple of N_CHUNK and the WIDEST plane
-    a multiple of W_TILE (pad the sketch config, not the data).
+    2**inv_log2_buckets) uint32 or None, qt_delta (qt_buckets,) f32 or
+    None). The invertible deltas come back already bitcast to uint32
+    with lanes ordered (count, keysum, fpsum) per row. The quantile
+    delta is the DDSketch bucket histogram of the `values` lane (uint32,
+    required when qt_buckets > 0); zero values carry no positive-bucket
+    weight. n must be a multiple of N_CHUNK and the WIDEST plane a
+    multiple of W_TILE (pad the sketch config, not the data).
     `interpret=True` runs the kernel in the Pallas interpreter — how the
     parity tier exercises the kernel math on CPU CI."""
     n = hh_keys.shape[0]
     wmax = max(1 << log2_width, 1 << ent_log2_width, 1 << hll_p,
-               (1 << inv_log2_buckets) if inv_rows else 0)
+               (1 << inv_log2_buckets) if inv_rows else 0,
+               qt_buckets)
     assert n % N_CHUNK == 0 and wmax % W_TILE == 0
+    if qt_buckets:
+        assert values is not None, "qt plane needs the value lane"
     n_chunks = n // N_CHUNK
-    n_planes = depth + 2 + 3 * inv_rows
+    n_planes = depth + 2 + 3 * inv_rows + (1 if qt_buckets else 0)
     tiles = wmax // W_TILE
     shape2 = (n_chunks, N_CHUNK)
     w2 = weights.astype(jnp.float32).reshape(shape2)
+    # static DDSketch constants, folded into the trace exactly as the
+    # reference ops.quantiles._bucket_index computes them on the host
+    gamma = (1.0 + qt_alpha) / (1.0 - qt_alpha)
+    qt_ilg = 1.0 / math.log(gamma) if qt_buckets else 0.0
+    qt_off = math.log(qt_min_value) * qt_ilg if qt_buckets else 0.0
     kernel = functools.partial(
         _fused_kernel, depth=depth, log2_width=log2_width,
         ent_log2_width=ent_log2_width, hll_p=hll_p, inv_rows=inv_rows,
-        inv_log2_buckets=inv_log2_buckets, n_chunks=n_chunks)
+        inv_log2_buckets=inv_log2_buckets, qt_buckets=qt_buckets,
+        qt_inv_log_gamma=qt_ilg, qt_offset=qt_off,
+        qt_min_value=qt_min_value, n_chunks=n_chunks)
     batch_spec = pl.BlockSpec(shape2, lambda p, t: (0, 0))
+    operands = [hh_keys.reshape(shape2), distinct_keys.reshape(shape2),
+                dist_keys.reshape(shape2), w2]
+    if qt_buckets:
+        operands.append(values.reshape(shape2))
     out = pl.pallas_call(
         kernel,
         grid=(n_planes, tiles),
-        in_specs=[batch_spec] * 4,
+        in_specs=[batch_spec] * len(operands),
         out_specs=pl.BlockSpec((1, 1, 8, 128), lambda p, t: (p, t, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((n_planes, tiles, 8, 128),
                                        jnp.float32),
         interpret=interpret,
-    )(hh_keys.reshape(shape2), distinct_keys.reshape(shape2),
-      dist_keys.reshape(shape2), w2)
+    )(*operands)
     out = out.reshape(n_planes, wmax)
     inv_delta = None
     if inv_rows:
-        inv_bits = out[depth + 2:, :1 << inv_log2_buckets]
+        inv_bits = out[depth + 2:depth + 2 + 3 * inv_rows,
+                       :1 << inv_log2_buckets]
         inv_delta = jax.lax.bitcast_convert_type(
             inv_bits, jnp.uint32).reshape(inv_rows, 3,
                                           1 << inv_log2_buckets)
+    qt_delta = (out[depth + 2 + 3 * inv_rows, :qt_buckets]
+                if qt_buckets else None)
     return (out[:depth, :1 << log2_width],
             out[depth, :1 << ent_log2_width],
             out[depth + 1, :1 << hll_p],
-            inv_delta)
+            inv_delta,
+            qt_delta)

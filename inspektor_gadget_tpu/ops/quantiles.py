@@ -15,9 +15,15 @@ Merge = bucket-wise add, exactly like the log2 histogram the reference
 drains from its BPF map — but with tunable accuracy and a zero/underflow
 bucket.
 
-TPU-first: the state is one (n_buckets,) float32 row; a batch update is a
+TPU-first: the state is one (n_buckets,) int32 row; a batch update is a
 one-hot matmul histogram (MXU path, same trick as ops/pallas_kernels.py)
-or scatter-add — both static-shape, jit/psum friendly.
+or scatter-add — both static-shape, jit/psum friendly. The count lanes are
+int32 on purpose: float32 counts silently stop incrementing past 2^24
+(x + 1 == x), so a long-lived per-bucket tally would quietly undercount.
+Integer adds stay exact to 2^31 and psum/merge are unchanged. The fused
+kernel's per-batch one-hot matmul still runs in f32 — exact because a
+single batch is far below 2^24 — and the delta is cast back to int32
+before accumulating.
 """
 
 from __future__ import annotations
@@ -27,13 +33,14 @@ import math
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @flax.struct.dataclass
 class DDSketch:
-    counts: jnp.ndarray   # (n_buckets,) float32 — log-gamma spaced
-    zeros: jnp.ndarray    # () float32 — values below min_value
-    total: jnp.ndarray    # () float32
+    counts: jnp.ndarray   # (n_buckets,) int32 — log-gamma spaced
+    zeros: jnp.ndarray    # () int32 — values below min_value
+    total: jnp.ndarray    # () int32
     alpha: float = flax.struct.field(pytree_node=False)
     min_value: float = flax.struct.field(pytree_node=False)
 
@@ -47,9 +54,9 @@ def dd_init(alpha: float = 0.01, n_buckets: int = 2048,
     """alpha = target relative error (1% default); 2048 buckets at 1%
     span ~1e-9..1e9 — nanoseconds to ~30s of latency in one row."""
     return DDSketch(
-        counts=jnp.zeros((n_buckets,), jnp.float32),
-        zeros=jnp.zeros((), jnp.float32),
-        total=jnp.zeros((), jnp.float32),
+        counts=jnp.zeros((n_buckets,), jnp.int32),
+        zeros=jnp.zeros((), jnp.int32),
+        total=jnp.zeros((), jnp.int32),
         alpha=alpha,
         min_value=min_value,
     )
@@ -68,8 +75,9 @@ def dd_update(state: DDSketch, values: jnp.ndarray,
     """Fold a batch of non-negative values (e.g. latencies in seconds).
     Masked/padded slots pass weight 0; exact zeros land in the zero
     bucket, as in the reference DDSketch."""
-    w = jnp.ones(values.shape, jnp.float32) if mask is None else mask.astype(jnp.float32)
-    is_zero = (values <= 0).astype(jnp.float32) * w
+    w = (jnp.ones(values.shape, jnp.int32) if mask is None
+         else mask.astype(jnp.int32))
+    is_zero = jnp.where(values <= 0, w, 0)
     w_pos = w - is_zero
     idx = _bucket_index(state, values)
     counts = state.counts.at[idx].add(w_pos)
@@ -85,8 +93,10 @@ def dd_quantile(state: DDSketch, q) -> jnp.ndarray:
     midpoint 2·gamma^i/(gamma+1) ⇒ relative error ≤ alpha. Returns 0.0 for
     ranks inside the zero bucket; NaN when the sketch is empty."""
     qs = jnp.atleast_1d(jnp.asarray(q, jnp.float32))
-    rank = qs * jnp.maximum(state.total - 1.0, 0.0)
-    cum = state.zeros + jnp.cumsum(state.counts)
+    total = state.total.astype(jnp.float32)
+    rank = qs * jnp.maximum(total - 1.0, 0.0)
+    cum = (state.zeros.astype(jnp.float32)
+           + jnp.cumsum(state.counts.astype(jnp.float32)))
     # first bucket whose cumulative count exceeds the rank
     bucket = (cum[None, :] <= rank[:, None]).sum(axis=1)
     bucket = jnp.clip(bucket, 0, state.counts.shape[0] - 1)
@@ -95,9 +105,9 @@ def dd_quantile(state: DDSketch, q) -> jnp.ndarray:
     # DDSketch estimate for bucket b: 2·γ^b/(γ+1), shifted by min_value
     mid = (2.0 * jnp.exp((bucket.astype(jnp.float32) + offset) * log_gamma)
            / (state.gamma + 1.0))
-    in_zero = rank < state.zeros
+    in_zero = rank < state.zeros.astype(jnp.float32)
     out = jnp.where(in_zero, 0.0, mid)
-    out = jnp.where(state.total > 0, out, jnp.nan)
+    out = jnp.where(total > 0, out, jnp.nan)
     return out[0] if jnp.ndim(q) == 0 else out
 
 
@@ -129,4 +139,51 @@ def dd_histogram_log2(state: DDSketch, n_slots: int = 27) -> jnp.ndarray:
                * 1e6)
     slot = jnp.clip(jnp.floor(jnp.log2(jnp.maximum(mids_us, 1.0))),
                     0, n_slots - 1).astype(jnp.int32)
-    return jnp.zeros((n_slots,), jnp.float32).at[slot].add(state.counts)
+    return jnp.zeros((n_slots,), jnp.int32).at[slot].add(state.counts)
+
+
+# -- host twins (numpy, float64) --------------------------------------------
+#
+# Sealed windows carry the raw DDSketch lanes as numpy arrays; the query
+# and CLI layers read quantiles off the merged fold on the host without
+# touching a device. Same formulas as the jnp versions above, in float64.
+
+def dd_quantile_np(counts: np.ndarray, zeros: float, total: float, q,
+                   *, alpha: float = 0.01,
+                   min_value: float = 1e-9) -> np.ndarray:
+    """Host-side quantile read over raw DDSketch lanes (e.g. a merged
+    window fold). Scalar q → scalar; array q → array."""
+    gamma = (1.0 + alpha) / (1.0 - alpha)
+    qs = np.atleast_1d(np.asarray(q, np.float64))
+    total = float(total)
+    rank = qs * max(total - 1.0, 0.0)
+    cum = float(zeros) + np.cumsum(np.asarray(counts, np.float64))
+    bucket = (cum[None, :] <= rank[:, None]).sum(axis=1)
+    bucket = np.clip(bucket, 0, len(cum) - 1)
+    log_gamma = math.log(gamma)
+    offset = math.log(min_value) / log_gamma
+    mid = 2.0 * np.exp((bucket + offset) * log_gamma) / (gamma + 1.0)
+    out = np.where(rank < float(zeros), 0.0, mid)
+    out = np.where(total > 0, out, np.nan)
+    return out[0] if np.ndim(q) == 0 else out
+
+
+def dd_histogram_log2_np(counts: np.ndarray, *, alpha: float = 0.01,
+                         min_value: float = 1e-9,
+                         n_slots: int = 27,
+                         unit_scale: float = 1e6) -> np.ndarray:
+    """Host-side log2 re-binning (the biolatency ASCII render input).
+    `unit_scale` converts bucket midpoints into the display unit before
+    the log2: 1e6 for seconds→µs (the device twin's convention), 1.0 to
+    bin raw integer-domain values (the bundle plane's ns lane) as-is."""
+    gamma = (1.0 + alpha) / (1.0 - alpha)
+    n = len(counts)
+    log_gamma = math.log(gamma)
+    offset = math.log(min_value) / log_gamma
+    mids = np.exp((np.arange(n, dtype=np.float64) + offset)
+                  * log_gamma) * unit_scale
+    slot = np.clip(np.floor(np.log2(np.maximum(mids, 1.0))),
+                   0, n_slots - 1).astype(np.int64)
+    out = np.zeros((n_slots,), np.int64)
+    np.add.at(out, slot, np.asarray(counts, np.int64))
+    return out

@@ -27,6 +27,7 @@ from .entropy import (EntropySketch, entropy_estimate, entropy_init,
                       entropy_merge, entropy_update)
 from .hll import HLL, hll_estimate, hll_init, hll_merge, hll_update
 from .invertible import InvSketch, inv_init, inv_merge, inv_update
+from .quantiles import DDSketch, dd_init, dd_merge, dd_update
 from .topk import TopK, topk_init, topk_merge, topk_update
 
 
@@ -43,6 +44,12 @@ class SketchBundle:
     # plane-off config) is unchanged; when present it rides every merge
     # path for free — pairwise adds, cluster psum, lane stacking
     inv: InvSketch | None = None
+    # latency-quantile plane (ISSUE 16): the DDSketch row fed by the
+    # per-event VALUE lane (latency ns / byte size); same None-default
+    # contract as `inv` — plane-off treedefs, digests and checkpoints are
+    # byte-identical to pre-plane builds, plane-on merges ride dd_merge /
+    # dd_psum on every path
+    quantiles: DDSketch | None = None
 
 
 def bundle_init(
@@ -54,7 +61,14 @@ def bundle_init(
     k: int = 128,
     inv_rows: int = 0,
     inv_log2_buckets: int = 12,
+    quantiles: bool = False,
+    quantile_alpha: float = 0.01,
+    quantile_buckets: int = 2048,
+    quantile_min_value: float = 1.0,
 ) -> SketchBundle:
+    # quantile_min_value defaults to 1.0 because the value lane is an
+    # integer domain (nanoseconds / bytes): bucket 0 starts at 1 unit and
+    # exact zeros go to the dedicated zero bucket
     return SketchBundle(
         cms=cms_init(depth, log2_width),
         hll=hll_init(hll_p),
@@ -63,7 +77,17 @@ def bundle_init(
         events=jnp.zeros((), jnp.float32),
         drops=jnp.zeros((), jnp.float32),
         inv=(inv_init(inv_rows, inv_log2_buckets) if inv_rows else None),
+        quantiles=(dd_init(alpha=quantile_alpha, n_buckets=quantile_buckets,
+                           min_value=quantile_min_value)
+                   if quantiles else None),
     )
+
+
+def _values_or_zero(values, like: jnp.ndarray) -> jnp.ndarray:
+    """Sources without a value lane feed zeros — every event lands in
+    the DDSketch zero bucket, keeping totals honest."""
+    return values if values is not None else jnp.zeros(like.shape,
+                                                       jnp.uint32)
 
 
 def bundle_update(
@@ -73,6 +97,7 @@ def bundle_update(
     dist_keys: jnp.ndarray,
     mask: jnp.ndarray,
     drops: jnp.ndarray | None = None,
+    values: jnp.ndarray | None = None,
 ) -> SketchBundle:
     w = mask.astype(jnp.int32)
     cms = cms_update(bundle.cms, hh_keys, w)
@@ -85,6 +110,9 @@ def bundle_update(
         drops=bundle.drops + (drops if drops is not None else 0.0),
         inv=(inv_update(bundle.inv, hh_keys, w)
              if bundle.inv is not None else None),
+        quantiles=(dd_update(bundle.quantiles,
+                             _values_or_zero(values, hh_keys), w)
+                   if bundle.quantiles is not None else None),
     )
 
 
@@ -99,6 +127,9 @@ def bundle_merge(a: SketchBundle, b: SketchBundle) -> SketchBundle:
         drops=a.drops + b.drops,
         inv=(inv_merge(a.inv, b.inv)
              if a.inv is not None and b.inv is not None else None),
+        quantiles=(dd_merge(a.quantiles, b.quantiles)
+                   if a.quantiles is not None and b.quantiles is not None
+                   else None),
     )
 
 
@@ -121,11 +152,13 @@ def fused_supported(bundle: SketchBundle, n: int) -> bool:
     chunks and the widest plane into lane tiles (pad the config, not the
     data); odd shapes take the reference path automatically. The
     invertible plane (when present) counts toward the widest plane like
-    every other lane."""
+    every other lane, as does the quantile row."""
     from .pallas_kernels import N_CHUNK, W_TILE
     wmax = max(bundle.cms.width, bundle.entropy.counts.shape[0],
                bundle.hll.registers.shape[0],
-               bundle.inv.buckets if bundle.inv is not None else 0)
+               bundle.inv.buckets if bundle.inv is not None else 0,
+               (bundle.quantiles.counts.shape[0]
+                if bundle.quantiles is not None else 0))
     return n % N_CHUNK == 0 and wmax % W_TILE == 0
 
 
@@ -136,6 +169,7 @@ def _bundle_update_pallas(
     dist_keys: jnp.ndarray,
     mask: jnp.ndarray,
     drops: jnp.ndarray | None = None,
+    values: jnp.ndarray | None = None,
     *,
     interpret: bool = False,
 ) -> SketchBundle:
@@ -149,11 +183,16 @@ def _bundle_update_pallas(
     w_i32 = mask.astype(jnp.int32)
     inv_rows = bundle.inv.rows if bundle.inv is not None else 0
     inv_lb = bundle.inv.log2_buckets if bundle.inv is not None else 0
-    cms_d, ent_d, ranks, inv_d = fused_sketch_planes(
-        hh_keys, distinct_keys, dist_keys, w_i32,
+    qt = bundle.quantiles
+    vals = (_values_or_zero(values, hh_keys) if qt is not None else None)
+    cms_d, ent_d, ranks, inv_d, qt_d = fused_sketch_planes(
+        hh_keys, distinct_keys, dist_keys, w_i32, vals,
         depth=bundle.cms.depth, log2_width=bundle.cms.log2_width,
         ent_log2_width=bundle.entropy.log2_width, hll_p=bundle.hll.p,
         inv_rows=inv_rows, inv_log2_buckets=inv_lb,
+        qt_buckets=(qt.counts.shape[0] if qt is not None else 0),
+        qt_alpha=(qt.alpha if qt is not None else 0.01),
+        qt_min_value=(qt.min_value if qt is not None else 1.0),
         interpret=interpret)
     cms = bundle.cms.replace(
         table=bundle.cms.table + cms_d.astype(bundle.cms.table.dtype),
@@ -168,6 +207,15 @@ def _bundle_update_pallas(
             count=bundle.inv.count + inv_d[:, 0].astype(jnp.int32),
             keysum=bundle.inv.keysum + inv_d[:, 1],
             fpsum=bundle.inv.fpsum + inv_d[:, 2])
+    if qt is not None:
+        # zero/total accounting mirrors dd_update exactly; the kernel's
+        # per-batch f32 bucket histogram is an exact integer (< 2^24), so
+        # the int32 cast matches the reference scatter-add bit for bit
+        is_zero = jnp.where(vals <= 0, w_i32, 0)
+        qt = qt.replace(
+            counts=qt.counts + qt_d.astype(jnp.int32),
+            zeros=qt.zeros + is_zero.sum(),
+            total=qt.total + w_i32.sum())
     return bundle.replace(
         cms=cms,
         hll=bundle.hll.replace(registers=jnp.maximum(
@@ -178,6 +226,7 @@ def _bundle_update_pallas(
         events=bundle.events + mask.sum(dtype=jnp.float32),
         drops=bundle.drops + (drops if drops is not None else 0.0),
         inv=inv,
+        quantiles=qt,
     )
 
 
@@ -188,6 +237,7 @@ def bundle_update_fused(
     dist_keys: jnp.ndarray,
     mask: jnp.ndarray,
     drops: jnp.ndarray | None = None,
+    values: jnp.ndarray | None = None,
 ) -> SketchBundle:
     """Drop-in bundle_update replacement: fused Pallas pass on TPU with
     aligned shapes, the reference composition everywhere else. Both paths
@@ -196,9 +246,9 @@ def bundle_update_fused(
             and jax.default_backend() == "tpu"
             and fused_supported(bundle, hh_keys.shape[0])):
         return _bundle_update_pallas(bundle, hh_keys, distinct_keys,
-                                     dist_keys, mask, drops)
+                                     dist_keys, mask, drops, values)
     return bundle_update(bundle, hh_keys, distinct_keys, dist_keys, mask,
-                         drops)
+                         drops, values)
 
 
 def bundle_ingest_step(
@@ -208,6 +258,7 @@ def bundle_ingest_step(
     dist_keys: jnp.ndarray,
     weights: jnp.ndarray,
     drops: jnp.ndarray | None = None,
+    values: jnp.ndarray | None = None,
 ) -> tuple[SketchBundle, jnp.ndarray]:
     """THE staged-ingest step every hot path shares (tpusketch, bench.py,
     perf harness) — two contracts live here, once:
@@ -224,7 +275,7 @@ def bundle_ingest_step(
       token buffer is never donated downstream.
     """
     out = bundle_update_fused(bundle, hh_keys, distinct_keys, dist_keys,
-                              weights.astype(jnp.int32), drops)
+                              weights.astype(jnp.int32), drops, values)
     return out, out.events + 0.0
 
 
@@ -290,6 +341,22 @@ def make_bundle_ingest_sharded(mesh, like: SketchBundle):
 
     specs = _lane_specs(like, P(NODE_AXIS))
     lane = P(NODE_AXIS)
+
+    if like.quantiles is not None:
+        # quantile-plane configs stage one more lane: (chips, batch)
+        # uint32 values, sharded like the key lanes
+        def body_qt(bund, hh, distinct, dist, weights, drops, values):
+            local = jax.tree.map(lambda x: x[0], bund)
+            out = bundle_update_fused(local, hh[0], distinct[0], dist[0],
+                                      weights[0].astype(jnp.int32),
+                                      drops[0], values[0])
+            return jax.tree.map(lambda x: x[None], out), out.events[None]
+
+        return jax.jit(
+            shard_map(body_qt, mesh=mesh,
+                      in_specs=(specs, lane, lane, lane, lane, lane, lane),
+                      out_specs=(specs, lane), check_vma=False),
+            donate_argnums=0)
 
     def body(bund, hh, distinct, dist, weights, drops):
         local = jax.tree.map(lambda x: x[0], bund)

@@ -36,6 +36,7 @@ from ..ops.countmin import cms_psum
 from ..ops.entropy import entropy_psum
 from ..ops.hll import hll_pmax
 from ..ops.invertible import inv_psum
+from ..ops.quantiles import dd_psum
 from ..ops.sketches import SketchBundle, bundle_init, bundle_update
 from ..ops.topk import topk_gather_merge
 from .compat import shard_map
@@ -122,7 +123,8 @@ def cluster_merge(bundle: SketchBundle) -> SketchBundle:
     """Collective merge of per-node bundles into the cluster view (runs
     under shard_map over the node axis). CMS/entropy psum, HLL pmax, top-k
     all_gather + re-rank vs the merged CMS, invertible lanes psum (the
-    whole point of the invertible plane: decode runs on THIS state)."""
+    whole point of the invertible plane: decode runs on THIS state),
+    DDSketch quantile row psum (cluster-wide latency distribution)."""
     local = jax.tree.map(lambda x: x[0], bundle)
     cms = cms_psum(local.cms, NODE_AXIS)
     merged = SketchBundle(
@@ -134,6 +136,8 @@ def cluster_merge(bundle: SketchBundle) -> SketchBundle:
         drops=jax.lax.psum(local.drops, NODE_AXIS),
         inv=(inv_psum(local.inv, NODE_AXIS)
              if local.inv is not None else None),
+        quantiles=(dd_psum(local.quantiles, NODE_AXIS)
+                   if local.quantiles is not None else None),
     )
     return merged
 

@@ -143,6 +143,7 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                 pipeline: str = "fused",
                 chips: int = 1,
                 invertible: bool = False,
+                quantiles: bool = False,
                 extra_provenance_probe: dict | None = None) -> dict:
     """Run one harness config; returns a validated PerfRecord dict.
 
@@ -169,6 +170,13 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     post-loop micro-measurement of the standalone invertible update (the
     merge-stage pattern).
 
+    `quantiles` adds the DDSketch latency plane to the bundle and a
+    synthetic ns-domain value lane to the staging block (fused pipeline
+    only — the value lane rides the folded SoA block). The record stays
+    in the SAME ledger series with extra.quantiles naming the shape; a
+    post-loop qt_update stage micro-measures the standalone DDSketch
+    fold at this batch shape (the inv_update pattern).
+
     The caller decides whether it lands in the ledger (cli/bench.py
     appends by default; tests pass their own tmp path)."""
     cfg = HARNESS_CONFIGS.get(config)
@@ -188,6 +196,11 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     if invertible and pipeline == "sharded":
         raise ValueError("--invertible measures the single-chip fused/"
                          "classic arms (the sharded arm's per-chip number "
+                         "comes from the same fused step)")
+    if quantiles and pipeline != "fused":
+        raise ValueError("--quantiles measures the fused arm (the value "
+                         "lane rides the folded staging block; classic "
+                         "has no values input, sharded's per-chip number "
                          "comes from the same fused step)")
     _tm_runs.labels(config=config).inc()
     window = cfg["seconds"] if seconds is None else float(seconds)
@@ -252,12 +265,23 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                            hll_p=cfg["hll_p"],
                            entropy_log2_width=cfg["entropy_log2_width"],
                            k=cfg["k"], inv_rows=inv_rows,
-                           inv_log2_buckets=inv_lb)
+                           inv_log2_buckets=inv_lb, quantiles=quantiles)
+
+    # synthetic ns-domain latencies for the value lane: precomputed once,
+    # copied into the pinned block per batch — the same host cost the
+    # operator pays filling the lane from a batch column
+    qt_lat = None
+    if quantiles:
+        from .quantile_bench import _latencies
+        qt_lat = np.minimum(_latencies(batch_n),
+                            np.float32(0xFFFFFFFF)).astype(np.uint32)
 
     # the shared staged-ingest step (update + fence token + weights-lane
     # semantics — the donation/fence contract is documented once, on
     # ops.sketches.bundle_ingest_step)
-    def fused_step(bundle, k, w):
+    def fused_step(bundle, k, w, v=None):
+        if quantiles:
+            return bundle_ingest_jit(bundle, k, k, k, w, None, v)
         return bundle_ingest_jit(bundle, k, k, k, w)
 
     with TRACER.span(f"perf/run/{config}",
@@ -269,7 +293,8 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
         pool = stager = None
         if pipeline == "fused":
             from ..sources.staging import H2DStager, PinnedBufferPool
-            pool = PinnedBufferPool(batch_n, lanes=2, max_free=4)
+            pool = PinnedBufferPool(batch_n, lanes=3 if quantiles else 2,
+                                    max_free=4)
             stager = H2DStager(pool, depth=2)
 
         # warm: compile + source ramp, outside every measured window.
@@ -298,9 +323,15 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                 blk[0][:wk.size] = wk
                 blk[0][wk.size:] = 0
             blk[1][:] = 1
-            k_d, w_d = stager.stage(blk, (blk[0], blk[1]))
-            for _ in range(2):
-                bundle, _tok = fused_step(bundle, k_d, w_d)
+            if quantiles:
+                blk[2][:] = qt_lat
+                k_d, w_d, v_d = stager.stage(blk, (blk[0], blk[1], blk[2]))
+                for _ in range(2):
+                    bundle, _tok = fused_step(bundle, k_d, w_d, v_d)
+            else:
+                k_d, w_d = stager.stage(blk, (blk[0], blk[1]))
+                for _ in range(2):
+                    bundle, _tok = fused_step(bundle, k_d, w_d)
             jax.block_until_ready(bundle.events)
             stager.drain()
         else:
@@ -340,12 +371,19 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                         block[1][:n] = 1
                         block[1][n:] = 0
                         drops += b.drops
+                    if quantiles:
+                        block[2][:] = qt_lat
                 with clock.stage("h2d_overlap", spans):
                     # async device put; overlaps the previous batch's
                     # fused_update, blocks only when >= depth ahead
-                    k, w = stager.stage(block, (block[0], block[1]))
+                    if quantiles:
+                        k, w, v = stager.stage(
+                            block, (block[0], block[1], block[2]))
+                    else:
+                        k, w = stager.stage(block, (block[0], block[1]))
+                        v = None
                 with clock.stage("fused_update", spans):
-                    bundle, tok = fused_step(bundle, k, w)
+                    bundle, tok = fused_step(bundle, k, w, v)
                     stager.fence(tok)
                     if (steps + 1) % cfg["sync_every"] == 0:
                         jax.block_until_ready(bundle.events)
@@ -422,6 +460,21 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                     inv_s = inv_step(inv_s, ik, iw)
                     jax.block_until_ready(inv_s.count)
 
+        if quantiles:
+            # standalone DDSketch fold at this batch shape (the
+            # inv_update pattern): the fused kernel absorbs the plane on
+            # the hot path, so this isolates what it costs per batch
+            from ..ops.quantiles import dd_init, dd_update
+            qt_step = jax.jit(dd_update, donate_argnums=0)
+            qt_s = dd_init(0.01, 2048, min_value=1.0)
+            qv = jnp.asarray(qt_lat.astype(np.float32))
+            qt_s = qt_step(qt_s, qv)
+            jax.block_until_ready(qt_s.counts)  # compile
+            for _ in range(cfg["merges"]):
+                with clock.stage("qt_update", True):
+                    qt_s = qt_step(qt_s, qv)
+                    jax.block_until_ready(qt_s.counts)
+
         run_span.set_attr("events", events)
         run_span.set_attr("ev_per_s", round(events / max(elapsed, 1e-9), 1))
         trace_id = run_span.context.trace_id
@@ -468,7 +521,7 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
         events / max(host_secs, 1e-9), 1)
     impl = ("native" if native_gen is not None
             else "replay" if replay_src is not None else "py")
-    inv_tag = "+inv" if invertible else ""
+    inv_tag = ("+inv" if invertible else "") + ("+qt" if quantiles else "")
     if pipeline == "fused":
         extra_fields["pipeline"] = (
             f"pop_folded({'py-fold' if impl == 'py' else impl})"
@@ -480,6 +533,9 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     if invertible:
         extra_fields["invertible"] = True
         extra_fields["inv_geometry"] = f"{inv_rows}x2^{inv_lb}"
+    if quantiles:
+        extra_fields["quantiles"] = True
+        extra_fields["qt_geometry"] = "2048@alpha0.01"
     if replay_src is not None:
         # the journal digest IS part of the number's meaning: same
         # config + same digest → directly comparable records

@@ -41,9 +41,14 @@ SCHEMA_ID = "ig-tpu/perf-record/v1"
 #            the planes were on, the series key never forks), and
 #            inv_decode the pure-bucket peeling of merged state at
 #            harvest ticks
+#   quantiles (ISSUE 16): qt_update is the standalone DDSketch batch
+#            fold (on the hot path the fused kernel carries the plane —
+#            extra.quantiles marks the record) and qt_merge the
+#            bucket-wise sketch merge at cluster-fold shape
 STAGES = ("pop", "decode", "enrich", "fold32", "pop_folded", "h2d",
           "h2d_overlap", "h2d_lanes", "bundle_update", "fused_update",
-          "sharded_update", "inv_update", "inv_decode", "harvest", "merge")
+          "sharded_update", "inv_update", "inv_decode", "qt_update",
+          "qt_merge", "harvest", "merge")
 
 # stages whose seconds count as HOST-plane ingest cost (the acceptance
 # comparison pop_folded→h2d vs pop→decode→enrich→fold32 sums these)

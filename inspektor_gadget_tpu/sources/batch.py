@@ -57,14 +57,16 @@ class EventBatch:
         return raw.split(b"\0", 1)[0].decode("utf-8", "replace")
 
 
-# Lane order of the folded SoA block — rows 0..2 of one (lanes >= 3,
+# Lane order of the folded SoA block — rows 0..3 of one (lanes >= 3,
 # capacity) uint32 array per batch: a single pinned allocation carries
 # all lanes, so one pool slot == one batch and the native exporter fills
-# all three with one call. Blocks may carry extra rows (tpusketch's
-# staging pool allocates 4 lanes so the same pool serves the EventBatch
-# path); a block's shape must match the pool it came from or put()
-# drops it.
-FOLDED_LANES = ("keys", "weights", "mntns")
+# them with one call. The values lane (row 3, per-event magnitude for
+# the DDSketch quantile plane) is optional: 3-lane blocks simply don't
+# carry it and `FoldedBatch.values` reports None. Blocks may carry extra
+# rows (tpusketch's staging pool allocates 4+ lanes so the same pool
+# serves the EventBatch path); a block's shape must match the pool it
+# came from or put() drops it.
+FOLDED_LANES = ("keys", "weights", "mntns", "values")
 
 
 @dataclasses.dataclass
@@ -76,8 +78,12 @@ class FoldedBatch:
     key_hash (the sketch key width, no Python decode/fold pass), `weights`
     the per-event weight (1 today; reserved for capture-side aggregation),
     `mntns` the xor-folded mount-ns id (exact for real ns inodes < 2^32 —
-    the late-enrichment display key). The lanes are rows 0..2 of ONE
-    pinned (lanes >= 3, capacity) block owned by a PinnedBufferPool slot;
+    the late-enrichment display key). Blocks popped through
+    `ig_source_pop_folded2` additionally fill `values` (row 3): the
+    per-event magnitude — latency ns or byte count, saturate-cast from
+    the kind's aux1, 0 for kinds without one — feeding the DDSketch
+    quantile plane. The lanes are the leading rows of ONE pinned
+    (lanes >= 3, capacity) block owned by a PinnedBufferPool slot;
     consumers must release the block back to the SAME pool once the H2D
     transfer completes.
     """
@@ -86,6 +92,10 @@ class FoldedBatch:
     count: int                 # valid rows (rest is padding)
     seq: int = 0               # first event's sequence number
     drops: int = 0             # cumulative upstream drops at pop time
+    # True only when the producer actually FILLED row 3 (pop_folded2):
+    # legacy 4-lane pool blocks keep row 3 as scratch, so shape alone
+    # cannot prove the lane holds real magnitudes
+    has_values: bool = False
 
     @property
     def capacity(self) -> int:
@@ -102,3 +112,11 @@ class FoldedBatch:
     @property
     def mntns(self) -> "np.ndarray":
         return self.lanes[2]
+
+    @property
+    def values(self) -> "np.ndarray | None":
+        """Per-event magnitude lane (uint32 latency ns / bytes), or None
+        for batches popped without the value lane."""
+        if self.has_values and self.lanes.shape[0] >= 4:
+            return self.lanes[3]
+        return None

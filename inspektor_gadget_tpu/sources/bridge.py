@@ -138,6 +138,8 @@ def _load_and_bind(rebuild: bool):
     lib.ig_source_pop_batch.restype = i64
     lib.ig_source_pop_folded.argtypes = [u64, i64, p32, p32, p32]
     lib.ig_source_pop_folded.restype = i64
+    lib.ig_source_pop_folded2.argtypes = [u64, i64, p32, p32, p32, p32]
+    lib.ig_source_pop_folded2.restype = i64
     lib.ig_source_drops.argtypes = [u64]
     lib.ig_source_drops.restype = u64
     lib.ig_source_produced.argtypes = [u64]
@@ -369,23 +371,37 @@ class NativeCapture:
         b.drops = int(self._lib.ig_source_drops(self._h))
         return b
 
-    def pop_folded(self, block: np.ndarray) -> FoldedBatch:
-        """Drain the ring straight into a (3, capacity) pre-folded SoA
+    def pop_folded(self, block: np.ndarray,
+                   with_values: bool = False) -> FoldedBatch:
+        """Drain the ring straight into a (3+, capacity) pre-folded SoA
         block — keys/weights/mntns uint32 lanes, filled by ONE native
         crossing (`ig_source_pop_folded`) with zero per-event Python
         work. `block` is typically a PinnedBufferPool slot wrapped
         zero-copy (np.frombuffer over the pinned mmap), so the lanes the
         C++ exporter writes ARE the H2D staging buffer: no Event structs,
-        no decode, no separate fold pass."""
-        if block.shape[0] < 3 or block.dtype != np.uint32:
-            raise ValueError("pop_folded needs a (3, capacity) uint32 block")
-        got = self._lib.ig_source_pop_folded(
-            self._h, block.shape[1],
-            _p32(block[0]), _p32(block[1]), _p32(block[2]))
+        no decode, no separate fold pass. With `with_values=True` the
+        block needs a 4th lane and `ig_source_pop_folded2` additionally
+        fills it with the per-event magnitude (latency ns / bytes,
+        saturate-cast aux1; 0 for kinds without one) — the DDSketch
+        quantile plane's value lane, same single crossing."""
+        need = 4 if with_values else 3
+        if block.shape[0] < need or block.dtype != np.uint32:
+            raise ValueError(
+                f"pop_folded needs a ({need}, capacity) uint32 block")
+        if with_values:
+            got = self._lib.ig_source_pop_folded2(
+                self._h, block.shape[1],
+                _p32(block[0]), _p32(block[1]), _p32(block[2]),
+                _p32(block[3]))
+        else:
+            got = self._lib.ig_source_pop_folded(
+                self._h, block.shape[1],
+                _p32(block[0]), _p32(block[1]), _p32(block[2]))
         if got < 0:
             raise RuntimeError("pop_folded on destroyed source")
         fb = FoldedBatch(lanes=block, count=int(got), seq=self._seq,
-                         drops=int(self._lib.ig_source_drops(self._h)))
+                         drops=int(self._lib.ig_source_drops(self._h)),
+                         has_values=with_values)
         self._seq += int(got)
         return fb
 

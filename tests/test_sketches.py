@@ -767,3 +767,175 @@ def test_windowed_cms_merge_and_jit():
     m = wcms_merge(a, b)
     q = wcms_query(m, jnp.array([5, 6], dtype=jnp.uint32))
     assert q[0] == 4 and q[1] == 2
+
+
+def test_fused_kernel_parity_with_quantile_plane():
+    """Interpret-mode fused kernel vs the reference composition with the
+    DDSketch quantile plane ON: every bundle leaf — counts/zeros/total
+    value lanes included — is bit-identical, over ragged masks, a second
+    batch on live state, and with the invertible planes riding along."""
+    from inspektor_gadget_tpu.ops.sketches import _bundle_update_pallas
+
+    rng = np.random.default_rng(40)
+    for depth, log2w, entw, p, inv_rows, n, valid in (
+            (4, 10, 8, 8, 0, 256, 256),
+            (2, 12, 10, 7, 2, 512, 501),):    # ragged + inv planes too
+        leaves = _BUNDLE_LEAVES + ("quantiles.counts", "quantiles.zeros",
+                                   "quantiles.total")
+        if inv_rows:
+            leaves += ("inv.count", "inv.keysum", "inv.fpsum")
+        b0 = bundle_init(depth=depth, log2_width=log2w, hll_p=p,
+                         entropy_log2_width=entw, k=16,
+                         inv_rows=inv_rows, inv_log2_buckets=10,
+                         quantiles=True, quantile_buckets=2048)
+        hh, distinct, dist = _streams(rng, n)
+        vals = jnp.asarray(rng.lognormal(np.log(50_000.0), 1.2, n)
+                           .astype(np.float32).astype(np.uint32))
+        vals = vals.at[:5].set(0)            # exercise the zero bucket
+        mask = jnp.asarray(np.arange(n) < valid)
+        ref = bundle_update(b0, hh, distinct, dist, mask, jnp.float32(1),
+                            values=vals)
+        fused = _bundle_update_pallas(b0, hh, distinct, dist, mask,
+                                      jnp.float32(1), values=vals,
+                                      interpret=True)
+        for name in leaves:
+            assert np.array_equal(_leaf(ref, name), _leaf(fused, name)), \
+                (name, depth, inv_rows)
+        hh2, d2, dd2 = _streams(rng, n)
+        vals2 = jnp.asarray(rng.integers(0, 1 << 20, n, dtype=np.uint32))
+        ref2 = bundle_update(ref, hh2, d2, dd2, mask, values=vals2)
+        fused2 = _bundle_update_pallas(fused, hh2, d2, dd2, mask,
+                                       values=vals2, interpret=True)
+        for name in leaves:
+            assert np.array_equal(_leaf(ref2, name), _leaf(fused2, name)), \
+                ("second batch", name)
+
+
+def test_bundle_quantile_plane_matches_standalone_sketch():
+    """The bundle's value-lane fold must produce the exact DDSketch the
+    standalone dd_update produces over the same masked values — the
+    bundle plane is the same sketch, just riding the fused step."""
+    from inspektor_gadget_tpu.ops import dd_init, dd_update
+
+    rng = np.random.default_rng(41)
+    n = 512
+    b = bundle_init(depth=2, log2_width=10, hll_p=8,
+                    entropy_log2_width=6, k=8, quantiles=True,
+                    quantile_buckets=1024, quantile_alpha=0.02)
+    hh, distinct, dist = _streams(rng, n)
+    vals = rng.integers(0, 1 << 24, n, dtype=np.uint32)
+    vals[:17] = 0
+    mask = np.arange(n) < 400
+    got = bundle_update(b, hh, distinct, dist, jnp.asarray(mask),
+                        values=jnp.asarray(vals))
+    want = dd_update(dd_init(alpha=0.02, n_buckets=1024, min_value=1.0),
+                     jnp.asarray(vals.astype(np.float32)),
+                     jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(got.quantiles.counts),
+                                  np.asarray(want.counts))
+    assert int(got.quantiles.zeros) == int(want.zeros) == 17
+    assert int(got.quantiles.total) == int(want.total) == 400
+    # plane-off bundle: quantiles stays None and values= is refused
+    off = bundle_init(depth=2, log2_width=10, hll_p=8,
+                      entropy_log2_width=6, k=8)
+    assert off.quantiles is None
+    out = bundle_update(off, hh, distinct, dist, jnp.asarray(mask))
+    assert out.quantiles is None
+
+
+def test_window_digest_quantile_plane_conditional():
+    """Same digest discipline as the invertible plane: a window without
+    the quantile lanes hashes exactly as before the plane existed, the
+    lanes change the digest when present, and the codec roundtrips them
+    bit-for-bit."""
+    from inspektor_gadget_tpu.history import window_digest
+    from inspektor_gadget_tpu.history.window import (SealedWindow,
+                                                     decode_window,
+                                                     encode_window)
+
+    base = dict(
+        gadget="t", node="n", run_id="r", window=1, start_ts=1.0,
+        end_ts=2.0, events=10, drops=0,
+        cms=np.ones((2, 8), np.int32), hll=np.zeros(16, np.int32),
+        ent=np.zeros(8, np.float32),
+        topk_keys=np.array([5], np.uint32),
+        topk_counts=np.array([10], np.int64), slices={})
+    plain = SealedWindow(**base)
+    with_qt = SealedWindow(**base,
+                           qt_counts=np.arange(32, dtype=np.int64),
+                           qt_zeros=3, qt_total=499, qt_alpha=0.02,
+                           qt_min_value=1.0)
+    assert window_digest(plain) != window_digest(with_qt)
+    assert window_digest(plain) == window_digest(SealedWindow(**base))
+    h, payload = encode_window(with_qt)
+    back = decode_window(h, payload)
+    assert np.array_equal(back.qt_counts, with_qt.qt_counts)
+    assert back.qt_zeros == 3 and back.qt_total == 499
+    assert back.qt_alpha == 0.02 and back.qt_min_value == 1.0
+    assert window_digest(back) == window_digest(with_qt)
+    # plane-off window: no qt keys on the wire at all
+    h2, _ = encode_window(plain)
+    assert not any(k.startswith("qt_") for k in h2)
+
+
+def test_merge_windows_qt_plane_fold_and_refusal():
+    """Range-fold semantics for the quantile plane: matching-geometry
+    windows fold into lanes whose quantile read equals the ground-truth
+    combined stream; a plane-less window or a different alpha drops the
+    plane from the answer WITH a note — a mixed-base fold would render
+    confident-looking but wrong percentiles."""
+    import jax as _jax
+    from inspektor_gadget_tpu.history import merge_windows
+    from inspektor_gadget_tpu.history.window import SealedWindow
+    from inspektor_gadget_tpu.ops import dd_init, dd_update
+
+    step = _jax.jit(dd_update, donate_argnums=0)
+    rng = np.random.default_rng(42)
+
+    def window_of(i, vals, with_qt=True, alpha=0.01):
+        kw = {}
+        if with_qt:
+            s = step(dd_init(alpha=alpha, n_buckets=1024, min_value=1.0),
+                     jnp.asarray(vals))
+            kw = dict(qt_counts=np.asarray(s.counts),
+                      qt_zeros=int(s.zeros), qt_total=int(s.total),
+                      qt_alpha=alpha, qt_min_value=1.0)
+        return SealedWindow(
+            gadget="t", node="n", run_id="r", window=i,
+            start_ts=float(i), end_ts=float(i + 1),
+            events=len(vals), drops=0,
+            cms=np.zeros((2, 8), np.int32), hll=np.zeros(16, np.int32),
+            ent=np.zeros(8, np.float32),
+            topk_keys=np.zeros(4, np.uint32),
+            topk_counts=np.zeros(4, np.int64), slices={}, **kw)
+
+    v1 = rng.lognormal(np.log(30_000.0), 0.7, 600).astype(np.float32)
+    v2 = rng.lognormal(np.log(900_000.0), 0.7, 400).astype(np.float32)
+    w1, w2 = window_of(1, v1), window_of(2, v2)
+    merged = merge_windows([w1, w2])
+    assert merged.qt_total == 1000 and merged.qt_zeros == 0
+    both = np.concatenate([v1, v2])
+    for q in (0.5, 0.9, 0.99):
+        est = float(merged.quantile(q))
+        true = float(np.quantile(both, q))
+        assert abs(est - true) / true < 0.03, (q, est, true)
+    # the quantile_answer block is wire-shaped and self-describing
+    ans = merged.quantile_answer()
+    assert ans["total"] == 1000 and ans["alpha"] == 0.01
+    assert set(ans) >= {"p50", "p90", "p99", "p999"}
+    # histogram over the merged lanes conserves positive mass
+    hist = merged.histogram_log2()
+    assert int(hist.sum()) == merged.qt_total - merged.qt_zeros
+    # a plane-less window in the range → quantiles disabled, loudly
+    m2 = merge_windows([w1, window_of(3, v2, with_qt=False)])
+    assert m2.qt_counts is None and np.isnan(m2.quantile(0.5))
+    assert m2.quantile_answer() is None
+    assert any("latency quantiles disabled" in s for s in m2.skipped)
+    # a different log base (alpha) → refusal, not a silent mixed fold
+    m3 = merge_windows([w1, window_of(4, v2, alpha=0.05)])
+    assert m3.qt_counts is None
+    assert any("quantile geometry" in s for s in m3.skipped)
+    # order matters not: plane-less FIRST also disables with a note
+    m4 = merge_windows([window_of(5, v1, with_qt=False), w2])
+    assert m4.qt_counts is None
+    assert any("earlier window lacked" in s for s in m4.skipped)

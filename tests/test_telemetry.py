@@ -772,3 +772,63 @@ def test_agent_active_runs_gauge_baseline_across_shared_lifecycle():
         assert _default_metric("ig_agent_detached_runs") == detached_before
     finally:
         server.stop(grace=0.5)
+
+
+def test_quantile_plane_counters_follow_value_lane():
+    """ISSUE 16 satellite: the DDSketch plane's absorption accounting —
+    ig_sketch_quantile_events_total counts every event the value lane
+    absorbed, ig_sketch_quantile_zero_total the no-magnitude subset,
+    and a plane-OFF instance moves neither."""
+    from inspektor_gadget_tpu.operators import tpusketch
+    from inspektor_gadget_tpu.operators.operators import get as get_op
+    from inspektor_gadget_tpu.sources.batch import EventBatch
+
+    def make(quantiles: str):
+        desc = get("trace", "exec")
+        ctx = GadgetContext(desc)
+        p = get_op("tpusketch").instance_params().to_params()
+        p.set("enable", "true")
+        p.set("log2-width", "8")
+        p.set("hll-p", "6")
+        p.set("entropy-log2-width", "6")
+        p.set("topk", "8")
+        p.set("harvest-interval", "1h")
+        p.set("quantiles", quantiles)
+        return get_op("tpusketch").instantiate(ctx, None, p)
+
+    def batch(n, zeros):
+        b = EventBatch.alloc(n, with_comm=False)
+        b.cols["key_hash"][:] = np.arange(1, n + 1, dtype=np.uint64)
+        b.cols["aux1"][:] = 1000
+        b.cols["aux1"][:zeros] = 0
+        b.count = n
+        return b
+
+    def counter(name) -> float:
+        return sum(v for k, v in telemetry.snapshot().items()
+                   if k.startswith(name))
+
+    ev0 = counter("ig_sketch_quantile_events_total")
+    z0 = counter("ig_sketch_quantile_zero_total")
+    live_before = set(tpusketch._live)
+    on, off = make("true"), make("false")
+    try:
+        on.enrich_batch(batch(64, zeros=5))
+        assert counter("ig_sketch_quantile_events_total") == ev0 + 64
+        assert counter("ig_sketch_quantile_zero_total") == z0 + 5
+        # plane off: the counters must not move — there is no lane
+        off.enrich_batch(batch(64, zeros=5))
+        assert counter("ig_sketch_quantile_events_total") == ev0 + 64
+        assert counter("ig_sketch_quantile_zero_total") == z0 + 5
+        # counter discipline: both render in the Prometheus exposition
+        text = telemetry.render_prometheus()
+        assert "ig_sketch_quantile_events_total" in text
+        assert "ig_sketch_quantile_zero_total" in text
+    finally:
+        with tpusketch._live_mu:
+            fresh = [r for r in list(tpusketch._live) if r not in live_before]
+            insts = [tpusketch._live.pop(r) for r in fresh]
+        for inst in insts:
+            if getattr(inst, "_stager", None) is not None:
+                inst._stager.drain()
+            inst._stats.unregister()
