@@ -201,10 +201,14 @@ def config1d_display_path(seconds: float) -> dict:
 
     rate_comm, shown_comm = run_display("comm:proc-42")
     rate_pid, _ = run_display("pid:>4000000000")
+    # high-match filtered variant: the filter is pushed down but matches
+    # (nearly) every row, so every survivor still decodes + formats — the
+    # pushdown machinery's overhead with none of its selectivity win.
+    rate_hi, shown_hi = run_display("pid:>0")
     # unfiltered variant: every popped row decodes + formats (match rate
     # 100%) — the honest ceiling of the render path. The ≥5M ev/s claim is
     # the FILTERED path (filters pushed down columnar, survivors only);
-    # both land in the record so neither masquerades as the other.
+    # all variants land in the record so none masquerades as another.
     rate_all, shown_all = run_display("")
     value = round(min(rate_comm, rate_pid), 1)
     rec = {"config": "1d", "name": "trace-exec-display-path",
@@ -212,10 +216,14 @@ def config1d_display_path(seconds: float) -> dict:
            "value": value,
            "extra": {"comm_filter_ev_per_s": round(rate_comm, 1),
                      "numeric_filter_ev_per_s": round(rate_pid, 1),
+                     "highmatch_filter_ev_per_s": round(rate_hi, 1),
                      "unfiltered_ev_per_s": round(rate_all, 1),
                      "rows_shown_comm": shown_comm,
+                     "rows_shown_highmatch": shown_hi,
                      "rows_shown_unfiltered": shown_all,
-                     "note": "value/target are the filtered display path; "
+                     "note": "value/target are the low-match filtered "
+                             "display path; highmatch_filter_ev_per_s "
+                             "pays pushdown with ~100% survivors and "
                              "unfiltered_ev_per_s formats every row",
                      "target": DISPLAY_TARGET_EV_S}}
     # GUARDRAIL (VERDICT Weak #5): the ≥5M filtered-path claim is a

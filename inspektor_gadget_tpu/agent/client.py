@@ -141,6 +141,7 @@ class AgentClient:
         on_log: Callable[[str, int, str, dict], None] | None = None,
         on_message: Callable[[str, int, int], None] | None = None,
         on_window: Callable[[str, dict], None] | None = None,
+        on_query: Callable[[str, dict, bytes], None] | None = None,
         stop_event: threading.Event | None = None,
         trace_ctx=None,
         run_id: str | None = None,
@@ -326,6 +327,13 @@ class AgentClient:
                     if on_window:
                         on_window(self.node_name,
                                   header.get("window", {}))
+                elif t == wire.EV_QUERY:
+                    # standing-query materialized answer: header is the
+                    # query identity + coverage, payload the packed
+                    # sealed window (QueryWindows reply frame shape)
+                    if on_query:
+                        on_query(self.node_name,
+                                 header.get("query", {}), payload)
                 elif "error" in header:
                     out["error"] = header["error"]
                     if header.get("unknown_run"):

@@ -164,7 +164,7 @@ def _validate_sub_opts(opts: dict) -> str | None:
 _SUMMARY_KINDS = frozenset({
     wire.EV_SUMMARY, wire.EV_ALERT, wire.EV_WINDOW, wire.EV_RESULT,
     wire.EV_CONTROL_ACK, wire.EV_RESUME_ACK, wire.EV_DROP_NOTICE,
-    wire.EV_ATTACH_ACK,
+    wire.EV_ATTACH_ACK, wire.EV_QUERY,
 })
 
 
@@ -1277,6 +1277,15 @@ class AgentServer:
                                   "window": win_header})
         ctx.extra["on_window_sealed"] = on_window_sealed
 
+        # standing-query materialized answers ride the summary tier as
+        # EV_QUERY records (header: query identity + coverage digest;
+        # payload: one packed sealed window — the QueryWindows reply
+        # frame shape, so subscribers reuse the same decode path)
+        def on_query_answer(qheader: dict, qpayload: bytes):
+            push(wire.EV_QUERY, {"node": self.node_name,
+                                 "query": qheader}, qpayload)
+        ctx.extra["on_query_answer"] = on_query_answer
+
         # control reader: client stop requests cancel the context (or
         # detach the subscriber on a shared run)
         threading.Thread(target=self._control_loop,
@@ -1720,6 +1729,16 @@ class AgentServer:
             history_tiers = HISTORY.tier_stats(ttl=10.0)
         except Exception as e:  # noqa: BLE001 — debug dump stays best-effort
             history_tiers = {"error": repr(e)}
+        # standing-query accounting rides the debug dump the same way:
+        # one row per live query (coverage, refresh/publish counts,
+        # cache hit/miss/invalidation) so `ig-tpu watch --table` and
+        # `fleet queries` never need a store-walking RPC
+        standing_queries: list = []
+        try:
+            from ..queries import live_stats
+            standing_queries = live_stats()
+        except Exception as e:  # noqa: BLE001 — debug dump stays best-effort
+            standing_queries = [{"error": repr(e)}]
         # the node's alert table rides the same debug dump, so a remote
         # `ig-tpu alerts list` can read every agent's active alerts
         from ..alerts import ACTIVE as active_alerts
@@ -1728,6 +1747,7 @@ class AgentServer:
                "containers": containers,
                "alerts": active_alerts.all(),
                "history_tiers": history_tiers,
+               "standing_queries": standing_queries,
                # CRD-path state rides the same debug dump (the reference's
                # daemon dumps its trace list alongside containers)
                "traces": [{"name": t["metadata"]["name"],

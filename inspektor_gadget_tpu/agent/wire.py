@@ -36,6 +36,10 @@ EV_DROP_NOTICE = 11     # per-subscriber overload accounting: a slow
                         # labeled terminal record of a stalled subscriber)
 EV_ATTACH_ACK = 12      # shared-run attach acknowledgment OR typed
                         # admission refusal (attach.refused + reason)
+EV_QUERY = 13           # standing-query materialized answer (queries/):
+                        # header carries the query identity + coverage
+                        # digest, payload is one packed sealed window —
+                        # the same frame shape as a QueryWindows reply
 EV_LOG_SHIFT = 16       # type >> 16 = severity when nonzero
 
 # The one registry every EV_* wire id must appear in. Stream decoding,
@@ -57,6 +61,7 @@ WIRE_EVENT_IDS: dict[str, int] = {
     "EV_RESUME_ACK": EV_RESUME_ACK,
     "EV_DROP_NOTICE": EV_DROP_NOTICE,
     "EV_ATTACH_ACK": EV_ATTACH_ACK,
+    "EV_QUERY": EV_QUERY,
 }
 
 

@@ -52,6 +52,18 @@ def add_fleet_parser(sub) -> None:
     rp.add_argument("-o", "--output", default="table",
                     choices=["table", "json"])
     rp.set_defaults(func=cmd_fleet_runs)
+    qp = fsub.add_parser(
+        "queries", help="per-node standing queries: coverage, refresh/"
+        "publish counts, cache hit/miss/invalidation accounting")
+    qp.add_argument("--remote", default="",
+                    help="name=target[,...]; defaults to the local fleet")
+    qp.add_argument("--deadline", type=float, default=3.0,
+                    help="per-agent RPC deadline in seconds")
+    qp.add_argument("--gadget", default="",
+                    help="restrict to one gadget (category/name)")
+    qp.add_argument("-o", "--output", default="table",
+                    choices=["table", "json"])
+    qp.set_defaults(func=cmd_fleet_queries)
 
 
 def _probe_agent(node: str, target: str, deadline: float) -> dict:
@@ -207,4 +219,61 @@ def cmd_fleet_runs(args) -> int:
                   f"{run.get('gadget', ''):<16s} "
                   f"{run.get('live_subscribers', 0):>4d} {cls:<14s} "
                   f"{q:>9s} {drops:>6d} {evictions:>5d}  {state}")
+    return 0 if not any(r["error"] for r in per_node) else 1
+
+
+def cmd_fleet_queries(args) -> int:
+    """Operator view of the standing-query plane: one row per (node,
+    query) with covered windows, refresh/publish counts, and result-
+    cache accounting — `fleet runs`' companion for "who is watching
+    what, and is the cache earning its bytes"."""
+    targets = _resolve_targets(args)
+    if targets is None:
+        return 2
+    if not targets:
+        print("no agents (use deploy --local N or --remote)",
+              file=sys.stderr)
+        return 2
+    from ..agent.client import AgentClient
+    per_node: list[dict] = []
+    for node, target in targets.items():
+        row: dict = {"node": node, "target": target, "queries": [],
+                     "error": ""}
+        client = None
+        try:
+            client = AgentClient(target, node, rpc_deadline=args.deadline)
+            qrows = (client.dump_state().get("standing_queries") or [])
+            if args.gadget:
+                qrows = [q for q in qrows
+                         if q.get("gadget") == args.gadget]
+            row["queries"] = qrows
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            row["error"] = str(e)
+        finally:
+            if client is not None:
+                client.close()
+        per_node.append(row)
+    if args.output == "json":
+        print(json.dumps({"agents": per_node}, indent=2, default=str))
+        return 0 if not any(r["error"] for r in per_node) else 1
+    print(f"{'NODE':<12s} {'QUERY':<18s} {'GADGET':<16s} {'RANGE':>8s} "
+          f"{'WIN':>4s} {'EVENTS':>12s} {'TICKS':>6s} {'PUB':>5s} "
+          f"{'FOLDS':>6s} {'CACHE h/m/i':>12s}")
+    for r in per_node:
+        if r["error"]:
+            print(f"{r['node']:<12s} unreachable: {r['error']}")
+            continue
+        if not r["queries"]:
+            print(f"{r['node']:<12s} no standing queries")
+            continue
+        for q in r["queries"]:
+            cache = q.get("cache") or {}
+            cache_s = (f"{cache.get('hits', 0)}/{cache.get('misses', 0)}"
+                       f"/{cache.get('invalidations', 0)}")
+            print(f"{r['node']:<12s} {q.get('id', ''):<18s} "
+                  f"{q.get('gadget', ''):<16s} "
+                  f"{q.get('range_s', 0):>7.0f}s {q.get('windows', 0):>4d} "
+                  f"{q.get('events', 0):>12,d} {q.get('ticks', 0):>6d} "
+                  f"{q.get('published', 0):>5d} {q.get('folds', 0):>6d} "
+                  f"{cache_s:>12s}")
     return 0 if not any(r["error"] for r in per_node) else 1

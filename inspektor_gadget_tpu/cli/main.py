@@ -148,6 +148,10 @@ def build_parser() -> argparse.ArgumentParser:
     from .query import add_query_parser
     add_query_parser(sub)
 
+    # standing-query plane: live materialized answers + accounting
+    from .watch import add_watch_parser
+    add_watch_parser(sub)
+
     from .history import add_history_parser
     add_history_parser(sub)
 

@@ -381,6 +381,34 @@ def _probe_history_tiers() -> Window:
         return Window("history_tiers", False, repr(e))
 
 
+def _probe_standing_queries() -> Window:
+    """Standing-query-plane row: which continuous queries are live in
+    this process, how fresh their materialized answers are, and whether
+    the result cache is earning its bytes. No registered queries is
+    fine (the plane is opt-in); the row fails only when reading the
+    live registry itself breaks."""
+    try:
+        from .queries import live_stats
+        rows = live_stats()
+        if not rows:
+            return Window("standing_queries", True,
+                          "no standing queries registered (opt-in via "
+                          "the 'standing-queries' param)")
+        cache = rows[0].get("cache") or {}
+        per_q = ", ".join(
+            f"{r['id']}: {r['windows']}w/{r['range_s']:g}s "
+            f"({r['refreshed']} refreshes)"
+            for r in rows)
+        detail = (f"{len(rows)} quer{'y' if len(rows) == 1 else 'ies'} — "
+                  f"{per_q}; cache {cache.get('hits', 0)}h/"
+                  f"{cache.get('misses', 0)}m/"
+                  f"{cache.get('invalidations', 0)}i, "
+                  f"{cache.get('bytes', 0) / (1 << 10):.1f}KiB")
+        return Window("standing_queries", True, detail)
+    except Exception as e:  # noqa: BLE001
+        return Window("standing_queries", False, repr(e))
+
+
 def _probe_each_agent(probe_one):
     """The shared skeleton of the fleet-facing doctor rows: probe every
     locally-registered agent concurrently under a bounded deadline (the
@@ -532,8 +560,8 @@ _PROBES = (
     _probe_mountinfo, _probe_procfs, _probe_blktrace, _probe_tcpinfo,
     _probe_audit, _probe_captrace, _probe_fstrace, _probe_sockstate,
     _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
-    _probe_history_dir, _probe_history_tiers, _probe_fleet_health,
-    _probe_shared_runs, _probe_device_topology,
+    _probe_history_dir, _probe_history_tiers, _probe_standing_queries,
+    _probe_fleet_health, _probe_shared_runs, _probe_device_topology,
 )
 
 

@@ -480,7 +480,11 @@ class MergedWindows:
         return entropy_bits(self.ent)
 
     def heavy_hitters(self, k: int = 20) -> list[tuple[int, int]]:
-        order = sorted(self.candidates.items(), key=lambda kv: -kv[1])
+        # (-count, key) like merged_to_sealed: a stable -count sort
+        # would break ties by dict insertion order, making the rendered
+        # top-k depend on fold shape (flat vs incremental)
+        order = sorted(self.candidates.items(),
+                       key=lambda kv: (-kv[1], kv[0]))
         return [(key, int(c)) for key, c in order[:k] if key][:k]
 
     def slice_answer(self, key: str) -> dict | None:
@@ -492,8 +496,9 @@ class MergedWindows:
             "events": int(s["events"]),
             "distinct": slice_hll_estimate(s["hll"]),
             "entropy_bits": entropy_bits(s["ent"]),
-            "heavy_hitters": sorted(s["hh"].items(),
-                                    key=lambda kv: -kv[1])[:SLICE_HH_K],
+            "heavy_hitters": sorted(
+                s["hh"].items(),
+                key=lambda kv: (-kv[1], kv[0]))[:SLICE_HH_K],
         }
 
 
@@ -667,14 +672,19 @@ def merged_to_sealed(merged: MergedWindows, *, gadget: str, node: str,
     candidate union is kept WHOLE (bounded by windows × top-k), so the
     additive planes and top-k estimates survive re-merging downstream
     with no extra truncation error at this boundary."""
-    cand = sorted(merged.candidates.items(), key=lambda kv: -kv[1])
+    # tie-break by key, not just estimate: a stable -count sort would
+    # leak dict insertion order into the sealed bytes, making the digest
+    # depend on fold SHAPE (flat left-fold vs the standing-query plane's
+    # pairwise incremental fold). (-count, key) is a pure function of
+    # the candidate multiset, so every fold shape seals byte-identically.
+    cand = sorted(merged.candidates.items(), key=lambda kv: (-kv[1], kv[0]))
     slices: dict[str, dict] = {}
     for skey, s in merged.slices.items():
         slices[skey] = {
             "events": int(s["events"]),
             "hll": s["hll"],
             "ent": s["ent"],
-            "hh": sorted(s["hh"].items(), key=lambda kv: -kv[1]),
+            "hh": sorted(s["hh"].items(), key=lambda kv: (-kv[1], kv[0])),
         }
     win = SealedWindow(
         gadget=gadget, node=node, run_id=run_id, window=int(window),

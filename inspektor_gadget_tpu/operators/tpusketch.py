@@ -435,6 +435,32 @@ class TpuSketch(Operator):
                       validator=validate_int_range(lo=1 << 16),
                       description="rehydration cache budget (LRU by "
                                   "bytes, hit/miss counted)"),
+            # standing-query plane (queries/): continuous questions
+            # answered incrementally at each seal tick instead of
+            # re-folded per request; needs the history plane (the fold
+            # input IS the sealed-window stream)
+            ParamDesc(key="standing-queries", default="",
+                      description="standing-query document (JSON/YAML "
+                                  "list of {id, stats, range, key?, "
+                                  "top?, every?}) or @/path/to/file; "
+                                  "answers materialize at every seal "
+                                  "tick and publish on the summary tier"),
+            ParamDesc(key="query-cache-bytes", default=str(8 << 20),
+                      type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=1 << 10),
+                      description="digest-keyed result cache budget "
+                                  "(LRU by bytes; hits serve reads with "
+                                  "zero window folds)"),
+            ParamDesc(key="query-refresh", default="1",
+                      type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=1),
+                      description="default publish cadence in seal "
+                                  "ticks for queries without an "
+                                  "explicit 'every'"),
+            ParamDesc(key="query-max-range", default="24h",
+                      description="cap on any standing query's sliding "
+                                  "range (bounds per-query window "
+                                  "retention; duration, e.g. 24h)"),
         ])
 
     def instantiate(self, ctx: GadgetContext, gadget: Any,
@@ -720,6 +746,58 @@ class TpuSketchInstance(OperatorInstance):
                 # not see its windows as months old
                 self._hist_engine = CompactionEngine(
                     schedule, clock=self._hist_clock)
+        # -- standing-query plane (queries/) ------------------------------
+        # Same loud-validation discipline as the invertible/quantile
+        # matrices: every misconfig is a typed ParamError before the
+        # first batch, never a surprise mid-run.
+        self._sq_engine = None
+        sq_doc = (p.get("standing-queries").as_string()
+                  if "standing-queries" in p else "")
+        sq_cache_b = (p.get("query-cache-bytes").as_int()
+                      if "query-cache-bytes" in p else 8 << 20)
+        sq_refresh = (p.get("query-refresh").as_int()
+                      if "query-refresh" in p else 1)
+        sq_max_range = (p.get("query-max-range").as_duration()
+                        if "query-max-range" in p else 86400.0)
+        if not sq_doc:
+            if sq_cache_b != 8 << 20:
+                raise ParamError(
+                    "param 'query-cache-bytes': needs 'standing-queries' "
+                    "— the result cache fronts materialized answers")
+            if sq_refresh != 1:
+                raise ParamError(
+                    "param 'query-refresh': needs 'standing-queries' — "
+                    "the cadence applies to registered queries")
+            if sq_max_range != 86400.0:
+                raise ParamError(
+                    "param 'query-max-range': needs 'standing-queries' "
+                    "— the cap bounds registered queries' ranges")
+        else:
+            if not (p.get("history").as_bool() if "history" in p
+                    else False):
+                raise ParamError(
+                    "param 'standing-queries': needs 'history true' — "
+                    "materialized answers fold the sealed-window stream")
+            from ..queries import (QueryError, StandingQueryEngine,
+                                   load_queries, load_queries_file)
+            try:
+                if sq_doc.startswith("@"):
+                    specs = load_queries_file(
+                        sq_doc[1:], default_every=sq_refresh,
+                        max_range_s=sq_max_range)
+                else:
+                    specs = load_queries(
+                        sq_doc, default_every=sq_refresh,
+                        max_range_s=sq_max_range)
+            except QueryError as e:
+                raise ParamError(
+                    f"param 'standing-queries': {e}") from None
+            self._sq_engine = StandingQueryEngine(
+                specs, gadget=self._hist_gadget,
+                node=ctx.extra.get("node", "") or "",
+                cache_bytes=sq_cache_b)
+            from ..queries import engine as _queries_engine
+            _queries_engine.register(ctx.run_id, self._sq_engine)
         # checkpoint/resume: keyed by gadget identity so a restarted run
         # (new run_id) finds its predecessor's state
         self._ckpt_key = ctx.desc.full_name.replace("/", "-")
@@ -1519,6 +1597,25 @@ class TpuSketchInstance(OperatorInstance):
                           "digest": win.digest})
                 except Exception as he:  # noqa: BLE001 — announce only
                     _ckpt_log.warning("window announce failed: %r", he)
+            # standing queries fold the window ONLY after a successful
+            # append: the engine's coverage must never include a window
+            # the store dropped, or a cache hit would disagree with the
+            # ad-hoc recompute over what's actually fetchable
+            if self._sq_engine is not None:
+                try:
+                    pubs = self._sq_engine.on_seal(win, now=float(end))
+                except Exception as qe:  # noqa: BLE001 — observe only
+                    _ckpt_log.warning("standing-query refresh failed: "
+                                      "%r", qe)
+                    pubs = []
+                qhook = self.ctx.extra.get("on_query_answer")
+                if qhook is not None:
+                    for qheader, qpayload in pubs:
+                        try:
+                            qhook(qheader, qpayload)
+                        except Exception as qe:  # noqa: BLE001
+                            _ckpt_log.warning(
+                                "query answer publish failed: %r", qe)
         if self._hist_engine is not None:
             # time-gated background pass: sealed segments whose windows
             # aged past their level's horizon fold into super-windows
@@ -1734,6 +1831,9 @@ class TpuSketchInstance(OperatorInstance):
                     self._flush_round_locked()
                 for st in self._lane_stagers:
                     st.drain()
+            if self._sq_engine is not None:
+                from ..queries import engine as _queries_engine
+                _queries_engine.unregister(self.ctx.run_id)
             self._stats.unregister()
             if _ckpt_dir is not None:
                 # shutdown save stays best-effort, but failures are now

@@ -48,7 +48,8 @@ SCHEMA_ID = "ig-tpu/perf-record/v1"
 STAGES = ("pop", "decode", "enrich", "fold32", "pop_folded", "h2d",
           "h2d_overlap", "h2d_lanes", "bundle_update", "fused_update",
           "sharded_update", "inv_update", "inv_decode", "qt_update",
-          "qt_merge", "harvest", "merge")
+          "qt_merge", "harvest", "merge", "sq_refresh", "sq_recompute",
+          "sq_cache_hit")
 
 # stages whose seconds count as HOST-plane ingest cost (the acceptance
 # comparison pop_folded→h2d vs pop→decode→enrich→fold32 sums these)

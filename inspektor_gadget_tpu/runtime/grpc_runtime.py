@@ -428,6 +428,95 @@ class GrpcRuntime(Runtime):
             t.join()
         return results
 
+    def subscribe_query(
+        self,
+        *,
+        query_id: str,
+        gadget: str = "",
+        run_id: str = "",
+        on_answer: Callable[["Any", dict], None] | None = None,
+        stop_event: threading.Event | None = None,
+        priority: str = "low",
+        queue: int = 256,
+    ) -> dict:
+        """Fleet fan-out for ONE standing query: attach a summary-tier
+        subscriber to every node's matching shared run and fold each
+        node's materialized answer (EV_QUERY) client-side — the same
+        merge algebra QueryWindows replies fold with, so the fleet
+        answer is exactly what an ad-hoc fleet query over the same
+        coverage would compute. on_answer(answer, meta) fires on every
+        node refresh with the latest per-node windows folded;
+        meta carries per-node coverage digests and ticks. Blocks until
+        stop_event; returns per-node stream accounting."""
+        from ..history import answer_query
+        from ..history.query import unpack_frames
+        from ..history.window import decode_window
+
+        stop_event = stop_event or threading.Event()
+        latest: dict[str, tuple[dict, "Any"]] = {}
+        latest_mu = threading.Lock()
+
+        def on_query(node: str, qheader: dict, payload: bytes):
+            if qheader.get("id") != query_id:
+                return
+            frames, dropped_bytes = unpack_frames(payload)
+            if not frames:
+                return
+            win = decode_window(*frames[0])
+            with latest_mu:
+                latest[node] = (qheader, win)
+                snap = sorted(latest.items())
+            if on_answer is None:
+                return
+            answer = answer_query(
+                [w for _, (_, w) in snap],
+                key=(qheader.get("key") or None),
+                top=int(qheader.get("top", 20)),
+                dropped=([f"{node}: torn answer tail "
+                          f"({dropped_bytes} bytes)"]
+                         if dropped_bytes else None))
+            meta = {
+                "id": query_id,
+                "from_node": node,
+                "nodes": {n: {"tick": h.get("tick", 0),
+                              "windows": h.get("windows", 0),
+                              "coverage_digest":
+                                  h.get("coverage_digest", "")}
+                          for n, (h, _) in snap},
+            }
+            on_answer(answer, meta)
+
+        results: dict[str, dict] = {}
+        results_mu = threading.Lock()
+
+        def run_node(node: str):
+            client = self._client(node)
+            try:
+                rid = run_id
+                if not rid:
+                    rows = client.shared_runs(gadget=gadget)
+                    if not rows:
+                        raise RuntimeError(
+                            f"no live shared run for {gadget or '<any>'!r}")
+                    rid = rows[0]["run_id"]
+                out = client.run_gadget(
+                    "", "", attach_to=rid,
+                    subscriber={"tier": "summary", "priority": priority,
+                                "queue": int(queue)},
+                    on_query=on_query, stop_event=stop_event)
+            except Exception as e:  # noqa: BLE001 — per-node isolation
+                out = {"error": str(e)}
+            with results_mu:
+                results[node] = out
+
+        threads = [threading.Thread(target=run_node, args=(n,), daemon=True)
+                   for n in self.targets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results
+
     def run_gadget(
         self,
         ctx: GadgetContext,

@@ -167,6 +167,27 @@ def test_formatter_width_scaling(cols):
     assert all(len(line) <= 25 for line in f.format_table(events()).splitlines())
 
 
+def test_formatter_fast_cache_invalidated_by_visibility_change():
+    """Regression: format_event compiles per-column specs once (_fast)
+    and must recompile when the Columns layout changes AFTER the first
+    row rendered — a stale cache kept rendering hidden (e.g.
+    kubernetes-tagged) columns, disagreeing with the header."""
+    @dataclasses.dataclass
+    class KEv:
+        comm: str = col("", width=8)
+        pod: str = col("", width=12, tags=("kubernetes",))
+
+    kcols = Columns(KEv)
+    f = TextFormatter(kcols)
+    assert "pod-a" in f.format_event(KEv("bash", "pod-a"))
+    kcols.hide_tagged(["kubernetes"])
+    assert "pod" not in f.header().lower()
+    assert "pod-a" not in f.format_event(KEv("bash", "pod-a"))
+    # and back the other way: re-show in a new order via set_visible
+    kcols.set_visible(["pod", "comm"])
+    assert f.format_event(KEv("bash", "pod-a")).startswith("pod-a")
+
+
 def test_truncate_modes():
     assert truncate("abcdefgh", 5, "end") == "abcd…"
     assert truncate("abcdefgh", 5, "start") == "…efgh"

@@ -32,8 +32,8 @@ def test_probe_windows_names_and_shape():
                 "mountinfo", "procfs", "blktrace", "tcpinfo", "audit",
                 "captrace", "fstrace", "sockstate", "sigtrace",
                 "container_runtime", "capture_dir", "history_dir",
-                "history_tiers", "fleet_health", "shared_runs",
-                "device_topology"}
+                "history_tiers", "standing_queries", "fleet_health",
+                "shared_runs", "device_topology"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail
@@ -119,6 +119,32 @@ def test_shared_runs_row_reports_fleet_shared_state(monkeypatch):
     w = _probe_shared_runs()
     assert not w.ok
     assert "unreadable" in w.detail and "ghost" in w.detail
+
+
+def test_standing_queries_row_reports_live_engines():
+    """The standing-query doctor row (ISSUE 17): no registered queries
+    is healthy (the plane is opt-in); with a live engine the row names
+    each query's coverage and the result-cache counters."""
+    from inspektor_gadget_tpu.doctor import _probe_standing_queries
+    from inspektor_gadget_tpu.queries import (
+        StandingQuery, StandingQueryEngine,
+    )
+    from inspektor_gadget_tpu.queries import engine as qengine
+
+    assert not [r for r in qengine.live_stats()
+                if r["run_id"] == "doctor-test"]
+    w = _probe_standing_queries()
+    if not qengine.live_engines():
+        assert w.ok and "opt-in" in w.detail
+    qengine.register("doctor-test", StandingQueryEngine(
+        [StandingQuery(id="hot", stats=("topk",), range_s=60.0)],
+        gadget="trace/exec", node="n0"))
+    try:
+        w = _probe_standing_queries()
+        assert w.ok
+        assert "hot" in w.detail and "cache" in w.detail
+    finally:
+        qengine.unregister("doctor-test")
 
 
 def test_gadget_report_covers_every_registered_gadget():

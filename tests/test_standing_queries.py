@@ -1,0 +1,688 @@
+"""Standing queries (ISSUE 17): seal-time materialized answers, the
+digest-keyed result cache, and the watch/fleet surfaces.
+
+The acceptance story under test: a registered continuous query is
+answered INCREMENTALLY — each seal tick folds exactly one new window
+into a running materialized answer via the two-stack sliding
+aggregation — and that answer is BYTE-IDENTICAL (same window digest) to
+an ad-hoc `answer_query` refold of the same sealed windows, at every
+tick, under eviction, compaction, restart+backfill, mixed plane
+coverage, and across a 2-node fleet. A repeat read within one coverage
+is a digest-keyed cache hit performing ZERO window folds (counter-
+asserted); a coverage move is a provable invalidation, never a TTL
+guess.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import inspektor_gadget_tpu.all_gadgets  # noqa: F401
+from inspektor_gadget_tpu.gadgets import GadgetContext, get
+from inspektor_gadget_tpu.history import HISTORY, answer_query, decode_frames
+from inspektor_gadget_tpu.history.query import pack_frames, unpack_frames
+from inspektor_gadget_tpu.history.window import (
+    decode_window,
+    encode_window,
+    merge_windows,
+    merged_to_sealed,
+    window_digest,
+)
+from inspektor_gadget_tpu.operators.operators import get as get_op
+from inspektor_gadget_tpu.params import ParamError
+from inspektor_gadget_tpu.perf.standing_bench import make_windows
+from inspektor_gadget_tpu.queries import (
+    QueryError,
+    ResultCache,
+    SlidingFold,
+    StandingQuery,
+    StandingQueryEngine,
+    live_engines,
+    live_stats,
+    load_queries,
+    load_queries_file,
+)
+from inspektor_gadget_tpu.queries import engine as queries_engine
+from inspektor_gadget_tpu.sources.batch import EventBatch
+
+GADGET = "trace/exec"
+
+QDOC = json.dumps([{"id": "hot", "stats": ["topk", "cardinality"],
+                    "range": "1h", "top": 8}])
+
+
+@pytest.fixture(autouse=True)
+def _release_instances():
+    """Instances built outside a real gadget run never see
+    post_gadget_run — drop them from the live tables (operator AND
+    standing-query registry) and drain their stagers so no state leaks
+    into other test files."""
+    from inspektor_gadget_tpu.operators import tpusketch
+    before = set(tpusketch._live)
+    before_q = {rid for rid, _ in live_engines()}
+    yield
+    with tpusketch._live_mu:
+        fresh = [rid for rid in list(tpusketch._live) if rid not in before]
+        insts = [tpusketch._live.pop(rid) for rid in fresh]
+    for inst in insts:
+        if getattr(inst, "_stager", None) is not None:
+            inst._stager.drain()
+        for st in getattr(inst, "_lane_stagers", []):
+            st.drain()
+        inst._stats.unregister()
+    for rid, _ in live_engines():
+        if rid not in before_q:
+            queries_engine.unregister(rid)
+
+
+@pytest.fixture()
+def fleet_store(tmp_path):
+    HISTORY.set_base_dir(str(tmp_path))
+    yield str(tmp_path)
+    HISTORY.close_all()
+    HISTORY.set_base_dir(None)
+
+
+def _make_instance(extra_params: dict, node: str = "",
+                   extra_ctx: dict | None = None):
+    desc = get("trace", "exec")
+    ctx = GadgetContext(desc, extra=dict(extra_ctx or {}))
+    if node:
+        ctx.extra["node"] = node
+    op = get_op("tpusketch")
+    p = op.instance_params().to_params()
+    p.set("enable", "true")
+    p.set("depth", "3")
+    p.set("log2-width", "10")
+    p.set("hll-p", "8")
+    p.set("entropy-log2-width", "6")
+    p.set("topk", "8")
+    p.set("harvest-interval", "1h")
+    for k, v in extra_params.items():
+        p.set(k, v)
+    return op.instantiate(ctx, None, p)
+
+
+def _batch(keys64: np.ndarray) -> EventBatch:
+    b = EventBatch.alloc(len(keys64), with_comm=False)
+    b.cols["key_hash"][:] = keys64
+    b.count = len(keys64)
+    return b
+
+
+_HIST = {"history": "true", "history-interval": "0",
+         "history-log2-width": "8", "history-slots": "2"}
+
+
+def _flat(wins, *, gadget="bench/standing", node="bench0"):
+    """The ad-hoc recompute: one flat left-fold over the covered
+    windows, sealed with the same normalization the engine uses."""
+    return merged_to_sealed(merge_windows(wins), gadget=gadget, node=node,
+                            window=0, run_id="")
+
+
+def _roundtrip(win):
+    """One pass through the wire codec. encode_window caps per-slice
+    heavy-hitter tables at SLICE_HH_K (the cut lands AFTER the
+    (-count, key) canonical sort, so every fold shape truncates to the
+    same top set); published standing payloads are encoded, so the
+    honest byte-level comparison is wire-vs-wire — the same contract
+    QueryWindows pushdown replies already live under."""
+    return decode_window(
+        *unpack_frames(pack_frames([encode_window(win)]))[0][0])
+
+
+# ---------------------------------------------------------------------------
+# registration grammar (spec.py): alert-rule discipline — loud at load
+# ---------------------------------------------------------------------------
+
+def test_load_queries_valid_forms():
+    qs = load_queries(QDOC)
+    assert len(qs) == 1 and qs[0].id == "hot"
+    assert qs[0].stats == ("topk", "cardinality")
+    assert qs[0].range_s == 3600.0 and qs[0].top == 8 and qs[0].every == 1
+    # wrapped form + numeric range + explicit every
+    qs = load_queries(json.dumps({"queries": [
+        {"id": "a", "stats": ["entropy"], "range": 30, "every": 3},
+        {"id": "b", "stats": ["quantiles"], "range": "15m",
+         "key": "mntns:42"}]}))
+    assert [q.id for q in qs] == ["a", "b"]
+    assert qs[0].every == 3 and qs[1].key == "mntns:42"
+    # default_every applies only where the doc is silent
+    qs = load_queries(json.dumps({"queries": [
+        {"id": "a", "stats": ["topk"], "range": 30, "every": 2},
+        {"id": "b", "stats": ["topk"], "range": 30}]}), default_every=6)
+    assert (qs[0].every, qs[1].every) == (2, 6)
+    assert "topk over last" in qs[0].describe()
+    assert json.loads(qs[0].identity())["id"] == "a"
+
+
+def test_load_queries_error_matrix():
+    cases = [
+        ("", "empty"),
+        ("[]", "no queries"),
+        ('{"watch": []}', "unknown top-level"),
+        ('"hot"', "expected a list"),
+        ('[42]', "expected an object"),
+        ('[{"stats": ["topk"], "range": 30}]', "id must match"),
+        ('[{"id": "bad id!", "stats": ["topk"], "range": 30}]',
+         "id must match"),
+        ('[{"id": "q", "stats": ["topk"], "range": 30, "topk": 5}]',
+         "unknown key"),
+        ('[{"id": "q", "range": 30}]', "stats must be"),
+        ('[{"id": "q", "stats": [], "range": 30}]', "stats must be"),
+        ('[{"id": "q", "stats": ["median"], "range": 30}]',
+         "unknown statistic"),
+        ('[{"id": "q", "stats": ["topk", "topk"], "range": 30}]',
+         "duplicate statistic"),
+        ('[{"id": "q", "stats": ["topk"]}]', "missing 'range'"),
+        ('[{"id": "q", "stats": ["topk"], "range": "soon"}]', "bad range"),
+        ('[{"id": "q", "stats": ["topk"], "range": -5}]', "must be > 0"),
+        ('[{"id": "q", "stats": ["topk"], "range": 30, "key": 7}]',
+         "key must be"),
+        ('[{"id": "q", "stats": ["topk"], "range": 30, "top": 0}]',
+         "top must be"),
+        ('[{"id": "q", "stats": ["topk"], "range": 30, "top": 99999}]',
+         "top must be"),
+        ('[{"id": "q", "stats": ["topk"], "range": 30, "every": 0}]',
+         "every must be"),
+        ('[{"id": "q", "stats": ["topk"], "range": 30},'
+         ' {"id": "q", "stats": ["topk"], "range": 60}]', "duplicate query"),
+    ]
+    for doc, match in cases:
+        with pytest.raises(QueryError, match=match):
+            load_queries(doc)
+
+
+def test_load_queries_range_cap_and_missing_file(tmp_path):
+    with pytest.raises(QueryError, match="exceeds the configured cap"):
+        load_queries(QDOC, max_range_s=60.0)
+    with pytest.raises(QueryError, match="cannot read query file"):
+        load_queries_file(str(tmp_path / "absent.json"))
+    p = tmp_path / "qs.json"
+    p.write_text(QDOC, encoding="utf-8")
+    assert load_queries_file(str(p))[0].id == "hot"
+
+
+# ---------------------------------------------------------------------------
+# the two-stack sliding fold: exact vs flat refold, at every tick
+# ---------------------------------------------------------------------------
+
+def test_sliding_fold_matches_flat_fold_every_tick():
+    """The tentpole invariant: after every push/evict, the incremental
+    value seals BYTE-IDENTICALLY (same digest) to a flat left-fold over
+    the covered windows — fold shape never leaks into the answer."""
+    wins = make_windows(12, width=32, hll_m=32, ent_w=16, k=4)
+    fold = SlidingFold(gadget="bench/standing", node="bench0")
+    live: list = []
+    range_s = 5.0
+    for w in wins:
+        fold.push(w)
+        live.append(w)
+        cutoff = w.end_ts - range_s
+        fold.evict_older_than(cutoff)
+        live = [x for x in live if x.end_ts >= cutoff]
+        assert fold.coverage() == frozenset(x.digest for x in live)
+        got = fold.value()
+        want = _flat(live)
+        want.digest = window_digest(want)
+        got2 = decode_window(*unpack_frames(
+            pack_frames([encode_window(got)]))[0][0])
+        assert window_digest(got) == want.digest
+        # and the encoded bytes round-trip to the same content
+        assert window_digest(got2) == want.digest
+    # eviction actually happened (12 one-second windows, 5s range)
+    assert len(fold) < 12
+
+
+def test_sliding_fold_amortized_folds():
+    """Refresh cost is amortized O(1) merges per tick: push is 2 seals,
+    value ≤ 1, and each window enters the front stack at most once —
+    total folds are linear in ticks, NOT ticks × range."""
+    wins = make_windows(64, width=16, hll_m=16, ent_w=8, k=2)
+    fold = SlidingFold(gadget="bench/standing", node="bench0")
+    for w in wins:
+        fold.push(w)
+        fold.evict_older_than(w.end_ts - 8.0)
+        fold.value()
+    assert fold.folds <= 4 * len(wins) + 8
+
+
+# ---------------------------------------------------------------------------
+# engine + digest-keyed result cache
+# ---------------------------------------------------------------------------
+
+def test_engine_repeat_read_is_zero_fold_cache_hit():
+    eng = StandingQueryEngine(
+        [StandingQuery(id="hot", stats=("topk",), range_s=3600.0)],
+        gadget="bench/standing", node="bench0")
+    assert eng.read("hot") is None  # empty range: nothing to answer
+    with pytest.raises(KeyError, match="no standing query 'nope'"):
+        eng.read("nope")
+    wins = make_windows(3, width=16, hll_m=16, ent_w=8, k=2)
+    pubs = eng.on_seal(wins[0], now=wins[0].end_ts)
+    assert len(pubs) == 1 and pubs[0][0]["schema"].startswith("ig-tpu/")
+    folds0 = eng._folds["hot"].folds
+    h1, p1, hit1 = eng.read("hot")
+    h2, p2, hit2 = eng.read("hot")
+    # on_seal already cached this coverage: both reads hit, zero folds
+    assert hit1 and hit2
+    assert eng._folds["hot"].folds == folds0
+    assert p1 == p2 and h1["coverage_digest"] == h2["coverage_digest"]
+    stats = eng.cache.stats()
+    assert stats["hits"] >= 2 and stats["entries"] == 1
+    # a new seal tick MOVES coverage: the old entry is provably stale
+    eng.on_seal(wins[1], now=wins[1].end_ts)
+    h3, _p3, _ = eng.read("hot")
+    assert h3["coverage_digest"] != h1["coverage_digest"]
+    assert h3["windows"] == 2
+    assert eng.cache.stats()["invalidations"] >= 1
+
+
+def test_engine_publish_cadence_and_stats():
+    eng = StandingQueryEngine(
+        [StandingQuery(id="hot", stats=("topk",), range_s=3600.0,
+                       every=2)],
+        gadget="bench/standing", node="bench0")
+    wins = make_windows(4, width=16, hll_m=16, ent_w=8, k=2)
+    published = [len(eng.on_seal(w, now=w.end_ts)) for w in wins]
+    # every=2: publish on ticks 2 and 4; refresh happens every tick
+    assert published == [0, 1, 0, 1]
+    row = eng.stats()[0]
+    assert row["id"] == "hot" and row["ticks"] == 4
+    assert row["refreshed"] == 4 and row["published"] == 2
+    assert row["windows"] == 4 and row["cache"]["entries"] == 1
+
+
+def test_result_cache_exact_coverage_and_lru():
+    cache = ResultCache(max_bytes=4096)
+    cov_a = frozenset({"d1", "d2"})
+    cache.put("a", cov_a, {"id": "a"}, b"x" * 64)
+    assert cache.get("a", cov_a) == ({"id": "a"}, b"x" * 64)
+    # coverage moved: provably stale — dropped + invalidation, then miss
+    assert cache.get("a", frozenset({"d2", "d3"})) is None
+    st = cache.stats()
+    assert st["invalidations"] == 1 and st["misses"] == 1 \
+        and st["entries"] == 0
+    # LRU-by-bytes: the budget holds ~2 entries; oldest is evicted
+    # WITHOUT counting an invalidation (nothing became stale)
+    for qid in ("a", "b", "c"):
+        cache.put(qid, frozenset({qid}), {"id": qid}, b"y" * 1500)
+    st = cache.stats()
+    assert st["entries"] == 2 and st["bytes"] <= 4096
+    assert st["invalidations"] == 1
+    assert cache.get("a", frozenset({"a"})) is None  # evicted
+    assert cache.get("c", frozenset({"c"})) is not None
+    with pytest.raises(ValueError, match="max_bytes"):
+        ResultCache(max_bytes=0)
+
+
+# ---------------------------------------------------------------------------
+# operator integration: param matrix + the seal-tick feed
+# ---------------------------------------------------------------------------
+
+def test_param_error_matrix():
+    # knobs without the feature: loud, named, before the first batch
+    with pytest.raises(ParamError, match="query-cache-bytes.*needs"):
+        _make_instance({"query-cache-bytes": "1024"})
+    with pytest.raises(ParamError, match="query-refresh.*needs"):
+        _make_instance({"query-refresh": "2"})
+    with pytest.raises(ParamError, match="query-max-range.*needs"):
+        _make_instance({"query-max-range": "1h"})
+    # the feature without its substrate
+    with pytest.raises(ParamError, match="needs 'history true'"):
+        _make_instance({"standing-queries": QDOC})
+    # a bad document answers as a ParamError naming the param
+    with pytest.raises(ParamError,
+                       match="standing-queries.*expected a list"):
+        _make_instance({"standing-queries": '{"queries": 42}', **_HIST})
+    with pytest.raises(ParamError, match="exceeds the configured cap"):
+        _make_instance({"standing-queries": QDOC,
+                        "query-max-range": "10m", **_HIST})
+    with pytest.raises(ParamError, match="cannot read query file"):
+        _make_instance({"standing-queries": "@/nonexistent/qs.json",
+                        **_HIST})
+    # grammar-level validators still answer at set() time
+    with pytest.raises(ParamError):
+        _make_instance({"standing-queries": QDOC, "query-cache-bytes": "0",
+                        **_HIST})
+
+
+def test_operator_seals_feed_engine_and_publish(fleet_store):
+    rng = np.random.default_rng(7)
+    pubs: list[tuple[dict, bytes]] = []
+    inst = _make_instance(
+        {"standing-queries": QDOC, **_HIST}, node="nA",
+        extra_ctx={"on_query_answer":
+                   lambda h, p: pubs.append((h, p))})
+    rid = inst.ctx.run_id
+    assert any(r == rid for r, _ in live_engines())
+    per_tick: list[bytes] = []
+    for _ in range(3):
+        inst.enrich_batch(_batch(
+            rng.integers(1, 1 << 32, 300, dtype=np.uint64)))
+        inst.seal_window()
+        assert pubs, "seal tick must publish the refreshed answer"
+        per_tick.append(pubs[-1][1])
+    HISTORY.release(inst._hist_writer)
+    # every published header speaks the wire schema
+    for h, _p in pubs:
+        assert h["schema"] == "ig-tpu/standing-query/v1"
+        assert h["id"] == "hot" and h["gadget"] == GADGET
+        assert h["node"] == "nA" and h["top"] == 8
+    assert [h["windows"] for h, _ in pubs] == [1, 2, 3]
+    # the engine's read serves the same bytes the wire published
+    eng = dict(live_engines())[rid]
+    header, payload, _cached = eng.read("hot")
+    assert payload == per_tick[-1]
+    assert header["coverage_digest"] == pubs[-1][0]["coverage_digest"]
+    # exactness AT EVERY TICK: each published answer is byte-identical
+    # to the flat answer_query-style refold of the windows sealed so far
+    frames = list(HISTORY.fetch_windows(base_dir=fleet_store,
+                                        gadget=GADGET))
+    wins = sorted(decode_frames(frames), key=lambda w: w.window)
+    assert len(wins) == 3
+    for i, payload_i in enumerate(per_tick):
+        std = decode_window(*unpack_frames(payload_i)[0][0])
+        want = _flat(wins[:i + 1], gadget=GADGET, node="nA")
+        assert window_digest(std) == window_digest(_roundtrip(want))
+    # and the rendered answers agree (the user-facing equivalence)
+    ad_hoc = answer_query(wins, top=8)
+    standing = answer_query([decode_window(
+        *unpack_frames(per_tick[-1])[0][0])], top=8)
+    assert standing.heavy_hitters == ad_hoc.heavy_hitters
+    assert standing.distinct == ad_hoc.distinct
+    assert standing.entropy_bits == ad_hoc.entropy_bits
+    assert standing.events == ad_hoc.events
+    # live_stats surfaces the accounting row for dump_state/doctor
+    rows = [r for r in live_stats() if r["run_id"] == rid]
+    assert rows and rows[0]["ticks"] == 3 and rows[0]["windows"] == 3
+
+
+# ---------------------------------------------------------------------------
+# churn matrix: compaction, restart+backfill, mixed planes, 2-node fleet
+# ---------------------------------------------------------------------------
+
+def test_standing_equals_recompute_across_compaction():
+    """Compaction rewrites the range into a super-window + raw tail;
+    the ad-hoc fold dedupes and re-merges. The standing answer (which
+    folded the raw seals) must render identically — compaction is a
+    lossless refold, not a new answer."""
+    wins = make_windows(4, width=32, hll_m=32, ent_w=16, k=4)
+    superw = merged_to_sealed(
+        merge_windows(wins[:2]), gadget="bench/standing", node="bench0",
+        level=1, window=1,
+        compacted_from=[{"digest": w.digest} for w in wins[:2]])
+    superw.digest = window_digest(superw)
+    eng = StandingQueryEngine(
+        [StandingQuery(id="q", stats=("topk",), range_s=3600.0)],
+        gadget="bench/standing", node="bench0")
+    for w in wins:
+        eng.on_seal(w, now=w.end_ts)
+    _h, payload, _ = eng.read("q")
+    standing = answer_query(
+        [decode_window(*unpack_frames(payload)[0][0])], top=8)
+    # the compacted store still holds a not-yet-GCed raw source window:
+    # dedupe must drop it, and the answer must match the standing fold
+    ad_hoc = answer_query([superw, wins[0], wins[2], wins[3]], top=8)
+    assert any("superseded" in n for n in ad_hoc.dropped_windows)
+    assert standing.heavy_hitters == ad_hoc.heavy_hitters
+    assert standing.distinct == ad_hoc.distinct
+    assert standing.entropy_bits == ad_hoc.entropy_bits
+    assert (standing.events, standing.drops) == (ad_hoc.events,
+                                                 ad_hoc.drops)
+
+
+def test_restart_backfill_rebuilds_identical_answer():
+    """An engine restarted from nothing and backfilled with the same
+    sealed windows (the store replay path) converges to the SAME
+    coverage digest and the SAME payload bytes."""
+    wins = make_windows(6, width=32, hll_m=32, ent_w=16, k=4)
+    spec = StandingQuery(id="q", stats=("topk", "cardinality"),
+                         range_s=4.0)
+    a = StandingQueryEngine([spec], gadget="bench/standing", node="bench0")
+    for w in wins:
+        a.on_seal(w, now=w.end_ts)
+    b = StandingQueryEngine([spec], gadget="bench/standing", node="bench0")
+    for w in wins:
+        b.on_seal(w, now=w.end_ts)
+    ha, pa, _ = a.read("q")
+    hb, pb, _ = b.read("q")
+    assert ha["coverage_digest"] == hb["coverage_digest"]
+    assert ha["windows"] == hb["windows"] < 6  # range evicted the head
+    assert pa == pb
+
+
+def test_mixed_plane_coverage_refusal_matches(fleet_store):
+    """One node seals with the quantile plane, one without: the standing
+    fold must refuse quantiles exactly like the ad-hoc fold (refusal is
+    an AND over windows — associative), not average partial coverage."""
+    rng = np.random.default_rng(9)
+    for node, qt in (("nA", "true"), ("nB", "false")):
+        inst = _make_instance({"quantiles": qt, **_HIST}, node=node)
+        b = _batch(rng.integers(1, 1 << 32, 200, dtype=np.uint64))
+        if qt == "true":
+            b.cols["aux1"][:] = rng.integers(1, 1 << 20, 200)
+        inst.enrich_batch(b)
+        inst.seal_window()
+        HISTORY.release(inst._hist_writer)
+    frames = list(HISTORY.fetch_windows(base_dir=fleet_store,
+                                        gadget=GADGET))
+    wins = decode_frames(frames)
+    assert len(wins) == 2
+    eng = StandingQueryEngine(
+        [StandingQuery(id="q", stats=("topk", "quantiles"),
+                       range_s=3600.0)], gadget=GADGET, node="")
+    for w in sorted(wins, key=lambda w: w.node):
+        eng.on_seal(w, now=max(x.end_ts for x in wins))
+    _h, payload, _ = eng.read("q")
+    standing = answer_query(
+        [decode_window(*unpack_frames(payload)[0][0])], top=8)
+    ad_hoc = answer_query(wins, top=8)
+    assert standing.quantiles is None and ad_hoc.quantiles is None
+    assert standing.histogram is None
+    assert standing.heavy_hitters == ad_hoc.heavy_hitters
+    assert standing.events == ad_hoc.events == 400
+
+
+def test_two_node_fleet_standing_matches_fleet_recompute(fleet_store):
+    """The fleet shape subscribe_query folds client-side: one standing
+    answer per node, merged at the client. That merge must equal the
+    ad-hoc fleet recompute over every node's sealed windows."""
+    rng = np.random.default_rng(11)
+    per_node: dict[str, bytes] = {}
+    for node in ("nA", "nB"):
+        inst = _make_instance({"standing-queries": QDOC, **_HIST},
+                              node=node)
+        rid = inst.ctx.run_id
+        for _ in range(2):
+            inst.enrich_batch(_batch(
+                rng.integers(1, 1 << 32, 250, dtype=np.uint64)))
+            inst.seal_window()
+        HISTORY.release(inst._hist_writer)
+        _h, payload, _ = dict(live_engines())[rid].read("hot")
+        per_node[node] = payload
+    std_wins = [decode_window(*unpack_frames(p)[0][0])
+                for p in per_node.values()]
+    frames = list(HISTORY.fetch_windows(base_dir=fleet_store,
+                                        gadget=GADGET))
+    raw_wins = decode_frames(frames)
+    assert len(raw_wins) == 4
+    # client-side merge of the two standing answers vs the full refold:
+    # byte-identical sealed content on every GLOBAL plane (digest
+    # excludes node identity). Per-slice heavy-hitter tables are
+    # compared only to truncation: each node's published answer already
+    # cut ITS union at SLICE_HH_K on encode, so the client-side merge
+    # holds the union of two capped tables while the raw refold holds
+    # the union of four — lossy exactly like the pushdown reply path.
+    merged_std = _flat(std_wins, gadget=GADGET, node="")
+    merged_raw = _flat(raw_wins, gadget=GADGET, node="")
+    assert window_digest(
+        dataclasses.replace(merged_std, slices={})) == window_digest(
+        dataclasses.replace(merged_raw, slices={}))
+    standing = answer_query(std_wins, top=8)
+    ad_hoc = answer_query(raw_wins, top=8)
+    assert standing.heavy_hitters == ad_hoc.heavy_hitters
+    assert standing.distinct == ad_hoc.distinct
+    assert standing.events == ad_hoc.events == 1000
+
+
+# ---------------------------------------------------------------------------
+# wire plane: EV_QUERY rides the summary tier
+# ---------------------------------------------------------------------------
+
+def test_ev_query_wire_roundtrip():
+    from inspektor_gadget_tpu.agent import wire
+    from inspektor_gadget_tpu.agent.service import _SUMMARY_KINDS
+    assert wire.EV_QUERY == 13
+    assert wire.WIRE_EVENT_IDS["EV_QUERY"] == wire.EV_QUERY
+    # summary-tier subscribers receive standing answers without raw
+    # batches — EV_QUERY must be in the tier's allow set
+    assert wire.EV_QUERY in _SUMMARY_KINDS
+    win = make_windows(1, width=16, hll_m=16, ent_w=8, k=2)[0]
+    payload = pack_frames([encode_window(win)])
+    header = {"node": "n0", "query": {"id": "hot", "tick": 1}}
+    data = wire.encode_msg(header, payload)
+    h2, p2 = wire.decode_msg(data)
+    assert h2 == header
+    got = decode_window(*unpack_frames(p2)[0][0])
+    assert window_digest(got) == win.digest
+
+
+# ---------------------------------------------------------------------------
+# CLI: ig-tpu watch / fleet queries
+# ---------------------------------------------------------------------------
+
+class _Args:
+    id = ""
+    remote = ""
+    local = False
+    list_queries = False
+    gadget = ""
+    run = ""
+    json = False
+    iterations = 0
+    duration = 0.0
+    interval = 0.01
+    top = 10
+    quantiles = False
+    deadline = 3.0
+    output = "table"
+
+
+def _registered_engine(run_id="run-watch-1"):
+    eng = StandingQueryEngine(
+        [StandingQuery(id="hot", stats=("topk",), range_s=3600.0)],
+        gadget="bench/standing", node="bench0")
+    for w in make_windows(2, width=16, hll_m=16, ent_w=8, k=2):
+        eng.on_seal(w, now=w.end_ts)
+    queries_engine.register(run_id, eng)
+    return eng
+
+
+def test_watch_list_local(capsys):
+    from inspektor_gadget_tpu.cli.watch import cmd_watch
+    _registered_engine()
+    args = _Args()
+    args.local = True
+    args.list_queries = True
+    assert cmd_watch(args) == 0
+    out = capsys.readouterr().out
+    assert "hot" in out and "QUERY" in out
+    args.output = "json"
+    assert cmd_watch(args) == 0
+    doc = json.loads(capsys.readouterr().out)
+    rows = [r for r in doc["queries"] if r["id"] == "hot"]
+    assert rows and rows[0]["windows"] == 2 and rows[0]["ticks"] == 2
+
+
+def test_watch_local_streams_json(capsys):
+    from inspektor_gadget_tpu.cli.watch import cmd_watch
+    _registered_engine()
+    args = _Args()
+    args.id = "hot"
+    args.local = True
+    args.json = True
+    args.iterations = 1
+    assert cmd_watch(args) == 0
+    line = capsys.readouterr().out.strip().splitlines()[0]
+    doc = json.loads(line)
+    assert doc["refresh"] == 1 and doc["meta"]["id"] == "hot"
+    assert doc["answer"]["windows"] == 1  # one merged standing window
+
+
+def test_watch_requires_id_or_list(capsys):
+    from inspektor_gadget_tpu.cli.watch import cmd_watch
+    args = _Args()
+    assert cmd_watch(args) == 2
+    assert "--id is required" in capsys.readouterr().err
+
+
+def test_watch_local_unknown_query(capsys):
+    from inspektor_gadget_tpu.cli.watch import cmd_watch
+    args = _Args()
+    args.id = "nope"
+    args.local = True
+    args.iterations = 1
+    assert cmd_watch(args) == 1
+    assert "no live engine" in capsys.readouterr().err
+
+
+def test_fleet_queries_renders_dump_state_rows(monkeypatch, capsys):
+    from inspektor_gadget_tpu.agent import client as agent_client
+    from inspektor_gadget_tpu.cli.fleet import cmd_fleet_queries
+
+    class _StubClient:
+        def __init__(self, target, node, rpc_deadline=3.0):
+            self.node = node
+
+        def dump_state(self):
+            return {"standing_queries": [{
+                "run_id": "r1", "id": "hot", "gadget": GADGET,
+                "stats": ["topk"], "key": "", "range_s": 900.0,
+                "every": 1, "windows": 4, "events": 1234, "ticks": 4,
+                "refreshed": 4, "published": 4, "folds": 13,
+                "cache": {"hits": 3, "misses": 1, "invalidations": 2,
+                          "entries": 1, "bytes": 2048,
+                          "max_bytes": 8 << 20}}]}
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(agent_client, "AgentClient", _StubClient)
+    args = _Args()
+    args.remote = "n0=localhost:19999"
+    assert cmd_fleet_queries(args) == 0
+    out = capsys.readouterr().out
+    assert "hot" in out and "3/1/2" in out and "1,234" in out
+
+
+# ---------------------------------------------------------------------------
+# perf: the economic pair lands as schema-valid ledger records
+# ---------------------------------------------------------------------------
+
+def test_standing_bench_publishes_valid_records(tmp_path):
+    from inspektor_gadget_tpu.perf.ledger import read_ledger
+    from inspektor_gadget_tpu.perf.schema import validate_record
+    from inspektor_gadget_tpu.perf.standing_bench import publish
+    ledger = str(tmp_path / "PERF.jsonl")
+    records = publish(range_small=4, range_large=8, steps=8,
+                      ledger=ledger)
+    assert [r["config"] for r in records] == [
+        "standing-refresh", "standing-recompute", "standing-cache-hit"]
+    for rec in records:
+        assert validate_record(rec) == []
+    refresh, recompute, cache = records
+    # the auditable independence pair: both range lengths in extra
+    assert refresh["extra"]["range_small"] == 4
+    assert refresh["extra"]["range_large"] == 8
+    assert refresh["extra"]["large_over_small"] > 0
+    assert recompute["extra"]["large_over_small"] > 0
+    # zero-fold cache reads, counter-asserted inside the bench
+    assert cache["extra"]["folds_during_reads"] == 0
+    on_disk = read_ledger(path=ledger)
+    assert len(on_disk.records) == 3 and not on_disk.skipped
