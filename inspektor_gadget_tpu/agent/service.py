@@ -1739,6 +1739,17 @@ class AgentServer:
             standing_queries = live_stats()
         except Exception as e:  # noqa: BLE001 — debug dump stays best-effort
             standing_queries = [{"error": repr(e)}]
+        # pipeline health (ISSUE 18): one row per live run — per-stage
+        # lag watermarks/quantiles, starved ratio, backpressure — so
+        # `ig-tpu fleet lag` and the doctor pipeline_health row read the
+        # hot path's health without a dedicated RPC
+        pipeline: list = []
+        try:
+            from ..telemetry.pipeline import live_stats as pipeline_stats
+            pipeline = [{"run_id": ps.run_id, "gadget": ps.gadget,
+                         **ps.snapshot()} for ps in pipeline_stats()]
+        except Exception as e:  # noqa: BLE001 — debug dump stays best-effort
+            pipeline = [{"error": repr(e)}]
         # the node's alert table rides the same debug dump, so a remote
         # `ig-tpu alerts list` can read every agent's active alerts
         from ..alerts import ACTIVE as active_alerts
@@ -1748,6 +1759,7 @@ class AgentServer:
                "alerts": active_alerts.all(),
                "history_tiers": history_tiers,
                "standing_queries": standing_queries,
+               "pipeline": pipeline,
                # CRD-path state rides the same debug dump (the reference's
                # daemon dumps its trace list alongside containers)
                "traces": [{"name": t["metadata"]["name"],

@@ -197,6 +197,20 @@ class AlertEngine:
             if v > 0.0:
                 base.append(v)
             return [("", v, trig)]
+        if rule.kind == "pipeline_lag":
+            # pipeline health regression vs the rolling baseline of the
+            # watched stage signal (host_lag/device_lag/starved_ratio).
+            # Same idle-window immunity as quantile_shift: a 0.0 reading
+            # means the health plane is off or the stage saw no traffic —
+            # "no observation" neither triggers nor enters the baseline
+            v = fields[rule.field]
+            base = rs.baseline
+            mean = sum(base) / len(base) if base else 0.0
+            trig = (len(base) > 0 and mean > 0.0
+                    and v > rule.factor * mean and v >= rule.threshold)
+            if v > 0.0:
+                base.append(v)
+            return [("", v, trig)]
         if rule.kind == "heavy_hitter_churn":
             hh = (summary.get("heavy_hitters") if isinstance(summary, dict)
                   else summary.heavy_hitters) or []

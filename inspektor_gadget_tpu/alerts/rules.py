@@ -46,7 +46,7 @@ from ..params.validators import parse_duration
 
 KINDS = ("threshold", "ratio", "entropy_jump", "cardinality_spike",
          "heavy_hitter_churn", "anomaly_score", "heavy_flow",
-         "quantile_shift")
+         "quantile_shift", "pipeline_lag")
 SEVERITIES = ("info", "warning", "critical")
 OPS = (">", ">=", "<", "<=")
 
@@ -59,6 +59,12 @@ SUMMARY_FIELDS = ("events", "drops", "distinct", "entropy_bits",
 # the percentiles a harvest's quantile block carries (operators/tpusketch
 # harvest → summary.quantiles); the only fields quantile_shift may watch
 QUANTILE_FIELDS = ("p50", "p90", "p99", "p999")
+
+# the pipeline health block's flat numeric fields (ISSUE 18, harvest →
+# summary.pipeline); the only fields pipeline_lag may watch. host_lag /
+# device_lag are the stage watermarks in seconds, starved_ratio the
+# starved / (starved + saturated) stager-tick fraction
+PIPELINE_FIELDS = ("host_lag", "device_lag", "starved_ratio")
 
 
 def decoded_pairs(summary) -> list[tuple[int, int]]:
@@ -89,6 +95,10 @@ def summary_fields(summary) -> dict[str, float]:
         hh = summary.heavy_hitters or []
         anomaly = summary.anomaly or {}
         quantiles = getattr(summary, "quantiles", None) or {}
+    if isinstance(summary, dict):
+        pipeline = summary.get("pipeline") or {}
+    else:
+        pipeline = getattr(summary, "pipeline", None) or {}
     top_count = float(hh[0][1]) if hh else 0.0
     return {
         "events": events,
@@ -103,6 +113,12 @@ def summary_fields(summary) -> dict[str, float]:
         # latency quantile plane: 0.0 when the plane is off or the window
         # was empty — quantile_shift treats 0 as "no observation"
         **{p: float(quantiles.get(p, 0.0)) for p in QUANTILE_FIELDS},
+        # pipeline health plane: 0.0 when absent — pipeline_lag shares
+        # quantile_shift's idle-window immunity (0 never enters the
+        # rolling baseline)
+        "host_lag": float(pipeline.get("host_lag_s", 0.0)),
+        "device_lag": float(pipeline.get("device_lag_s", 0.0)),
+        "starved_ratio": float(pipeline.get("starved_ratio", 0.0)),
     }
 
 
@@ -143,6 +159,9 @@ class AlertRule:
         elif self.kind == "quantile_shift":
             cond = (f"{self.field} > {self.factor:g}x mean(last "
                     f"{self.window}) (latency quantile plane)")
+        elif self.kind == "pipeline_lag":
+            cond = (f"{self.field} > {self.factor:g}x mean(last "
+                    f"{self.window}) (pipeline health plane)")
         else:  # anomaly_score
             cond = f"anomaly[mntns] {self.op} {self.threshold:g}"
         return (f"{self.id}: {cond} for {self.for_s:g}s "
@@ -227,6 +246,13 @@ def _parse_rule(raw: object, index: int) -> AlertRule:
                 f"rule {rid!r}: quantile_shift watches one of "
                 f"{list(QUANTILE_FIELDS)} (the harvest quantile block), "
                 f"got field={field!r}")
+    elif kind == "pipeline_lag":
+        field = field or "host_lag"
+        if field not in PIPELINE_FIELDS:
+            raise RuleError(
+                f"rule {rid!r}: pipeline_lag watches one of "
+                f"{list(PIPELINE_FIELDS)} (the harvest pipeline block), "
+                f"got field={field!r}")
 
     denom = raw.get("denom", "")
     if kind == "ratio":
@@ -238,11 +264,12 @@ def _parse_rule(raw: object, index: int) -> AlertRule:
     elif denom:
         raise RuleError(f"rule {rid!r}: 'denom' only applies to kind 'ratio'")
 
-    # cardinality_spike / quantile_shift trigger on `factor` x baseline;
-    # their threshold is an optional absolute floor. Every other kind
-    # requires one.
+    # cardinality_spike / quantile_shift / pipeline_lag trigger on
+    # `factor` x baseline; their threshold is an optional absolute
+    # floor. Every other kind requires one.
     if "threshold" not in raw and kind not in ("cardinality_spike",
-                                               "quantile_shift"):
+                                               "quantile_shift",
+                                               "pipeline_lag"):
         raise RuleError(f"rule {rid!r}: missing 'threshold'")
     threshold = _num(raw, "threshold", rid, 0.0)
     clear = None

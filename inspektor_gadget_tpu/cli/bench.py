@@ -133,6 +133,32 @@ def cmd_bench_run(args) -> int:
     if not args.no_ledger:
         path = append_record(rec, args.ledger)
         print(f"appended to {path}", file=sys.stderr)
+        # pipeline health plane (ISSUE 18): fused runs carry per-stage
+        # lag + starvation accounting; publish the device-plane p99 lag
+        # as its own `.pipeline-lag` series so `bench compare` gates lag
+        # regressions (unit seconds → lower_better) alongside throughput
+        stage_lag = (rec.get("extra") or {}).get("stage_lag") or {}
+        if "h2d" in stage_lag:
+            from ..perf.schema import make_record
+            lag_rec = make_record(
+                config=f"{rec['config']}.pipeline-lag",
+                metric="pipeline_device_lag_p99",
+                unit="seconds",
+                value=stage_lag["h2d"]["p99_s"],
+                stages={},
+                provenance=rec["provenance"],
+                extra={
+                    "starved_fraction":
+                        rec["extra"].get("starved_fraction", 0.0),
+                    "stall_s": rec["extra"].get("stall_s", 0.0),
+                    "stage_lag": stage_lag,
+                    "source_config": rec["config"],
+                })
+            append_record(lag_rec, args.ledger)
+            print(f"appended {lag_rec['config']} "
+                  f"(p99 {lag_rec['value']:.9f}s, starved "
+                  f"{lag_rec['extra']['starved_fraction']:.0%})",
+                  file=sys.stderr)
     else:
         print(f"not appended (--no-ledger); would use "
               f"{ledger_path(args.ledger)}", file=sys.stderr)

@@ -64,6 +64,24 @@ def add_fleet_parser(sub) -> None:
     qp.add_argument("-o", "--output", default="table",
                     choices=["table", "json"])
     qp.set_defaults(func=cmd_fleet_queries)
+    lp = fsub.add_parser(
+        "lag", help="per-node pipeline health: per-stage lag watermarks/"
+        "p99, batch rates, ring occupancy, starved ratio")
+    lp.add_argument("--remote", default="",
+                    help="name=target[,...]; defaults to the local fleet")
+    lp.add_argument("--deadline", type=float, default=3.0,
+                    help="per-agent RPC deadline in seconds")
+    lp.add_argument("--gadget", default="",
+                    help="restrict to one gadget (category/name)")
+    lp.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="re-poll every SECONDS and show batch rates "
+                         "from count deltas (0 = one shot)")
+    lp.add_argument("--iterations", type=int, default=0,
+                    help="with --watch: stop after N refreshes "
+                         "(0 = until interrupted)")
+    lp.add_argument("-o", "--output", default="table",
+                    choices=["table", "json"])
+    lp.set_defaults(func=cmd_fleet_lag)
 
 
 def _probe_agent(node: str, target: str, deadline: float) -> dict:
@@ -276,4 +294,107 @@ def cmd_fleet_queries(args) -> int:
                   f"{q.get('events', 0):>12,d} {q.get('ticks', 0):>6d} "
                   f"{q.get('published', 0):>5d} {q.get('folds', 0):>6d} "
                   f"{cache_s:>12s}")
+    return 0 if not any(r["error"] for r in per_node) else 1
+
+
+def _poll_pipeline(targets: dict, deadline: float,
+                   gadget: str) -> list[dict]:
+    """One DumpState sweep → [{node, error, runs: [pipeline rows]}]."""
+    from ..agent.client import AgentClient
+    per_node: list[dict] = []
+    for node, target in targets.items():
+        row: dict = {"node": node, "target": target, "runs": [],
+                     "error": ""}
+        client = None
+        try:
+            client = AgentClient(target, node, rpc_deadline=deadline)
+            runs = client.dump_state().get("pipeline") or []
+            runs = [r for r in runs if "error" not in r]
+            if gadget:
+                runs = [r for r in runs if r.get("gadget") == gadget]
+            row["runs"] = runs
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            row["error"] = str(e)
+        finally:
+            if client is not None:
+                client.close()
+        per_node.append(row)
+    return per_node
+
+
+def _fmt_lag(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.2f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.1f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def _print_lag_table(per_node: list[dict], prev: dict, dt: float) -> dict:
+    """Render one poll; returns {key: count} for the next poll's rate
+    column (batches/s from count deltas — a DumpState snapshot carries
+    totals, not rates)."""
+    print(f"{'NODE':<12s} {'RUN':<14s} {'STAGE':<8s} {'RATE':>9s} "
+          f"{'LAG':>9s} {'P99':>9s} {'OCC':>4s} {'STARVED':>8s}")
+    counts: dict = {}
+    for r in per_node:
+        if r["error"]:
+            print(f"{r['node']:<12s} unreachable: {r['error']}")
+            continue
+        if not r["runs"]:
+            print(f"{r['node']:<12s} no instrumented runs")
+            continue
+        for run in r["runs"]:
+            rid = str(run.get("run_id", ""))[:14]
+            starved = f"{run.get('starved_ratio', 0.0) * 100:.0f}%"
+            occ = run.get("occupancy") or {}
+            for stage, srow in sorted((run.get("stages") or {}).items()):
+                key = (r["node"], run.get("run_id"), stage)
+                counts[key] = srow.get("count", 0)
+                delta = counts[key] - prev.get(key, 0)
+                rate = (f"{delta / dt:,.0f}/s"
+                        if dt > 0 and key in prev else "-")
+                o = max((v for k, v in occ.items()
+                         if k.split(":", 1)[0] == stage), default=0.0)
+                print(f"{r['node']:<12s} {rid:<14s} {stage:<8s} "
+                      f"{rate:>9s} "
+                      f"{_fmt_lag(srow.get('watermark_s', 0.0)):>9s} "
+                      f"{_fmt_lag(srow.get('p99_s', 0.0)):>9s} "
+                      f"{o:>4.0f} {starved:>8s}")
+    return counts
+
+
+def cmd_fleet_lag(args) -> int:
+    """Operator view of the pipeline health plane (ISSUE 18): one row
+    per (node, run, stage) with batch rate, lag watermark, p99 lag, ring
+    occupancy, and starved ratio — the live form of the BENCH_r04
+    starvation gap. `--watch` re-polls and turns count deltas into
+    rates."""
+    import time as _time
+    targets = _resolve_targets(args)
+    if targets is None:
+        return 2
+    if not targets:
+        print("no agents (use deploy --local N or --remote)",
+              file=sys.stderr)
+        return 2
+    prev: dict = {}
+    last_t = 0.0
+    i = 0
+    while True:
+        per_node = _poll_pipeline(targets, args.deadline, args.gadget)
+        now = _time.monotonic()
+        if args.output == "json":
+            print(json.dumps({"agents": per_node}, indent=2, default=str))
+        else:
+            prev = _print_lag_table(per_node, prev,
+                                    now - last_t if last_t else 0.0)
+        last_t = now
+        i += 1
+        if not args.watch or (args.iterations and i >= args.iterations):
+            break
+        try:
+            _time.sleep(args.watch)
+        except KeyboardInterrupt:
+            break
     return 0 if not any(r["error"] for r in per_node) else 1

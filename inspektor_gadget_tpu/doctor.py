@@ -534,6 +534,36 @@ def _probe_device_topology() -> Window:
         return Window("device_topology", False, repr(e))
 
 
+def _probe_pipeline_health() -> Window:
+    """Pipeline-health-plane row (ISSUE 18): which gadget runs in this
+    process carry live per-stage lag accounting, their worst-stage lag
+    watermark, and the starved ratio (1.0 = host-bound, the BENCH_r04
+    regime; 0.0 = device-bound). No live runs is fine — the plane rides
+    every tpusketch run automatically, so an idle process simply has
+    nothing to report; the row fails only when reading the registry
+    breaks (`ig-tpu fleet lag` gives the per-node detail)."""
+    try:
+        from .telemetry.pipeline import live_stats
+        rows = live_stats()
+        if not rows:
+            return Window("pipeline_health", True,
+                          "no live instrumented runs (the plane rides "
+                          "every tpusketch run)")
+        per_run = []
+        for ps in rows:
+            snap = ps.snapshot()
+            worst = max((r["watermark_s"]
+                         for r in snap["stages"].values()), default=0.0)
+            per_run.append(
+                f"{ps.run_id[:8]}: lag {worst * 1e3:.1f}ms, "
+                f"starved {snap['starved_ratio'] * 100:.0f}%")
+        return Window("pipeline_health", True,
+                      f"{len(rows)} instrumented run(s) — "
+                      + ", ".join(per_run))
+    except Exception as e:  # noqa: BLE001
+        return Window("pipeline_health", False, repr(e))
+
+
 def _probe_mountinfo() -> Window:
     try:
         with open("/proc/self/mountinfo") as f:
@@ -562,6 +592,7 @@ _PROBES = (
     _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
     _probe_history_dir, _probe_history_tiers, _probe_standing_queries,
     _probe_fleet_health, _probe_shared_runs, _probe_device_topology,
+    _probe_pipeline_health,
 )
 
 

@@ -221,6 +221,12 @@ class SketchSummary:
     # state — {p50, p90, p99, p999, zeros, total, underflow, alpha};
     # None when the plane is off (pre-plane consumers see no new field)
     quantiles: dict | None = None
+    # pipeline health plane (ISSUE 18): PipelineStats.snapshot() at
+    # harvest time — per-stage lag watermarks/quantiles, starved vs
+    # saturated ticks, backpressure and occupancy. Excluded from
+    # summary digests (capture/journal.py whitelist), encoded on the
+    # wire only when present — pre-plane headers stay byte-identical
+    pipeline: dict | None = None
     # flat numeric access for detector rules lives in ONE place:
     # alerts.rules.summary_fields (handles this dataclass and the
     # wire-decoded dict shape alike)
@@ -679,6 +685,12 @@ class TpuSketchInstance(OperatorInstance):
         from ..gadgets.top.sketch import SketchStatsSource
         self._stats = SketchStatsSource(ctx.run_id, ctx.desc.full_name)
         self._stats.register()
+        # pipeline health plane (ISSUE 18): per-stage lag watermarks,
+        # starved/saturated stager ticks, backpressure — fed by the
+        # stagers and the ingest loop, read by harvest/DumpState/doctor
+        from ..telemetry.pipeline import PipelineStats
+        self._pstats = PipelineStats(ctx.run_id, ctx.desc.full_name)
+        self._pstats.register()
         # -- sketch-history plane (sealed windows, history/) --------------
         self._hist_on = p.get("history").as_bool() if "history" in p else False
         if self._hist_on:
@@ -820,6 +832,21 @@ class TpuSketchInstance(OperatorInstance):
         return TRACER.span(name, parent=cur if cur is not None
                            else self._trace_parent, attrs=attrs)
 
+    def _note_watermarks(self, pop_ts: float, oldest_ts: float,
+                         lane: int = 0) -> None:
+        """Batch-grain lag watermarks (pipeline health plane): host lag
+        = pop − oldest event, device lag = dispatch (now) − pop — two
+        clock reads per BATCH, nothing per event. Unstamped batches
+        (0.0 fields: non-bridge producers) degrade to zero lag rather
+        than an epoch-sized one."""
+        now = time.time()
+        if pop_ts <= 0.0:
+            pop_ts = now
+        if oldest_ts <= 0.0 or oldest_ts > pop_ts:
+            oldest_ts = pop_ts
+        self._pstats.note_host_lag(pop_ts - oldest_ts, lane)
+        self._pstats.note_device_lag(max(now - pop_ts, 0.0), lane)
+
     # -- invertible plane helpers (ISSUE 15) --------------------------------
 
     @staticmethod
@@ -922,7 +949,8 @@ class TpuSketchInstance(OperatorInstance):
             self._pool = PinnedBufferPool(pad,
                                           lanes=5 if self._qt_on else 4,
                                           max_free=self._h2d_depth + 2)
-            self._stager = H2DStager(self._pool, depth=self._h2d_depth)
+            self._stager = H2DStager(self._pool, depth=self._h2d_depth,
+                                     stats=self._pstats)
         self._pad = max(self._pad, pad)
         return self._pool, self._stager
 
@@ -965,7 +993,7 @@ class TpuSketchInstance(OperatorInstance):
                 for k in range(self._chips)]
             self._lane_stagers = [
                 H2DStager(self._lane_pools[k], depth=self._h2d_depth,
-                          device=devices[k])
+                          device=devices[k], stats=self._pstats)
                 for k in range(self._chips)]
             # one cached zero lane per chip: the filler a flushed
             # partial round rides. Never donated (only the bundle is),
@@ -1067,6 +1095,7 @@ class TpuSketchInstance(OperatorInstance):
                 self._lane_stagers[lane].fence_slot(
                     p["slot"], tuple([tok] + p["fences"]))
         self._pending = {}
+        self._pstats.note_round()
 
     def _flush_round_locked(self) -> None:
         self._dispatch_round_locked()
@@ -1091,6 +1120,7 @@ class TpuSketchInstance(OperatorInstance):
         pad = self._pad
         while pad < n:
             pad *= 2
+        lane = self._next_lane if self._shard_on else 0
 
         t0 = time.perf_counter()
         with self._span("tpusketch/h2d", events=n, pad=pad):
@@ -1217,6 +1247,15 @@ class TpuSketchInstance(OperatorInstance):
         self._stats.steps += 1
         self._stats.events += n
         self._stats.drops = batch.drops
+        # pipeline watermarks: prefer the batch's stamped fields; an
+        # unstamped batch with a real ts column recovers the oldest
+        # event from it (one vectorized min)
+        oldest = batch.oldest_ts
+        if oldest <= 0.0:
+            tmin = float(batch.cols["ts"][:n].min())
+            if tmin > 0.0:
+                oldest = tmin / 1e9
+        self._note_watermarks(batch.pop_ts, oldest, lane)
         # late enrichment (display-only work off the ingest path): two
         # vectorized slice writes park a small (k64, k32, comm) sample in
         # the rolling ring; name resolution happens at harvest/seal time
@@ -1246,6 +1285,7 @@ class TpuSketchInstance(OperatorInstance):
         if not self.enabled or fb.count == 0:
             return
         n = fb.count
+        lane = self._next_lane if self._shard_on else 0
         t0 = time.perf_counter()
         with self._span("tpusketch/h2d", events=n, pad=fb.capacity):
             _pool, stager = (self._lane_staging(fb.capacity)
@@ -1335,6 +1375,7 @@ class TpuSketchInstance(OperatorInstance):
         self._stats.steps += 1
         self._stats.events += n
         self._stats.drops = fb.drops
+        self._note_watermarks(fb.pop_ts, fb.oldest_ts, lane)
         if self._hist_on and self._hist_interval > 0 and \
                 self._hist_clock() - self._win_start >= self._hist_interval:
             self.seal_window()
@@ -1742,6 +1783,25 @@ class TpuSketchInstance(OperatorInstance):
                 "zeros": int(z), "total": int(t),
                 "underflow": int(c[0]), "alpha": float(self._qt_alpha),
             }
+        # pipeline health plane: snapshot the per-stage lag/starvation
+        # accounting and render one span per stage under this harvest's
+        # span — export_chrome then shows a real pipeline timeline with
+        # watermarks/quantiles in the span args (run/trace IDs thread
+        # through the ambient harvest context)
+        pipe_out = self._pstats.snapshot()
+        for stage, row in pipe_out["stages"].items():
+            with self._span(f"tpusketch/stage/{stage}",
+                            watermark_s=row["watermark_s"],
+                            p50_s=row["p50_s"], p99_s=row["p99_s"],
+                            count=row["count"]):
+                pass
+        if pipe_out["starved"] or pipe_out["saturated"]:
+            with self._span("tpusketch/stage/stager",
+                            starved=pipe_out["starved"],
+                            saturated=pipe_out["saturated"],
+                            starved_ratio=pipe_out["starved_ratio"],
+                            stall_s=pipe_out["stall_s"]):
+                pass
         # late enrichment: names resolve HERE (once per tick, from the
         # sample ring), not in the per-batch ingest path
         self._resolve_late([k for k, _ in hh[:32]])
@@ -1776,6 +1836,7 @@ class TpuSketchInstance(OperatorInstance):
             inv=inv_info,
             classes=classes_out,
             quantiles=qt_out,
+            pipeline=pipe_out,
         )
         # read the consumer LIVE from ctx.extra (falling back to the one
         # captured at init): the alerts operator chains its engine into
@@ -1835,6 +1896,7 @@ class TpuSketchInstance(OperatorInstance):
                 from ..queries import engine as _queries_engine
                 _queries_engine.unregister(self.ctx.run_id)
             self._stats.unregister()
+            self._pstats.unregister()
             if _ckpt_dir is not None:
                 # shutdown save stays best-effort, but failures are now
                 # logged, counted, and retried — never silently swallowed

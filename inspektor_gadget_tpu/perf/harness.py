@@ -290,12 +290,18 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                             "pipeline": pipeline}) as run_span:
         clock = _StageClock(run_span.context)
 
-        pool = stager = None
+        pool = stager = pstats = None
         if pipeline == "fused":
             from ..sources.staging import H2DStager, PinnedBufferPool
+            from ..telemetry.pipeline import PipelineStats
             pool = PinnedBufferPool(batch_n, lanes=3 if quantiles else 2,
                                     max_free=4)
-            stager = H2DStager(pool, depth=2)
+            # pipeline health plane (ISSUE 18): the harness runs the SAME
+            # instrumented stager as the operator, so the record carries
+            # starved-fraction + per-stage lag quantiles — BENCH_r04's
+            # starvation gap as a ledger series, not a one-off anecdote
+            pstats = PipelineStats(f"perf.{config}")
+            stager = H2DStager(pool, depth=2, stats=pstats)
 
         # warm: compile + source ramp, outside every measured window.
         # Replay journals may carry heterogeneous batch shapes, and each
@@ -353,6 +359,7 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
         while time.perf_counter() < deadline:
             spans = steps < SPAN_BATCHES
             if pipeline == "fused":
+                t_gen = time.perf_counter()
                 with clock.stage("pop_folded", spans):
                     block = pool.get()
                     if native_gen is not None:
@@ -373,6 +380,7 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                         drops += b.drops
                     if quantiles:
                         block[2][:] = qt_lat
+                t_pop = time.perf_counter()
                 with clock.stage("h2d_overlap", spans):
                     # async device put; overlaps the previous batch's
                     # fused_update, blocks only when >= depth ahead
@@ -382,6 +390,10 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                     else:
                         k, w = stager.stage(block, (block[0], block[1]))
                         v = None
+                # batch-grain watermarks, same clocks the operator uses:
+                # host lag = pop − generation, device lag = dispatch − pop
+                pstats.note_host_lag(t_pop - t_gen)
+                pstats.note_device_lag(time.perf_counter() - t_pop)
                 with clock.stage("fused_update", spans):
                     bundle, tok = fused_step(bundle, k, w, v)
                     stager.fence(tok)
@@ -536,6 +548,15 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     if quantiles:
         extra_fields["quantiles"] = True
         extra_fields["qt_geometry"] = "2048@alpha0.01"
+    if pstats is not None:
+        psnap = pstats.snapshot()
+        pstats.unregister()  # return the shared gauges to baseline
+        extra_fields["starved_fraction"] = round(psnap["starved_ratio"], 4)
+        extra_fields["stall_s"] = round(psnap["stall_s"], 6)
+        extra_fields["stage_lag"] = {
+            stage: {"p50_s": round(row["p50_s"], 9),
+                    "p99_s": round(row["p99_s"], 9)}
+            for stage, row in psnap["stages"].items()}
     if replay_src is not None:
         # the journal digest IS part of the number's meaning: same
         # config + same digest → directly comparable records

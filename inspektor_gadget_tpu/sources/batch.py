@@ -34,6 +34,11 @@ class EventBatch:
     seq: int = 0               # first event's sequence number
     drops: int = 0             # cumulative upstream drops at pop time
     comm: np.ndarray | None = None  # (capacity, 8) uint8 display prefixes
+    # pipeline-health watermarks (epoch seconds; 0.0 = unstamped): one
+    # stamp per BATCH, never per event — host lag = pop_ts − oldest_ts,
+    # device lag = dispatch − pop_ts (telemetry/pipeline.py)
+    pop_ts: float = 0.0        # wall clock when the host popped the batch
+    oldest_ts: float = 0.0     # oldest event timestamp in the batch
 
     @property
     def capacity(self) -> int:
@@ -96,6 +101,13 @@ class FoldedBatch:
     # legacy 4-lane pool blocks keep row 3 as scratch, so shape alone
     # cannot prove the lane holds real magnitudes
     has_values: bool = False
+    # pipeline-health watermarks (epoch seconds; 0.0 = unstamped). The
+    # folded lanes carry no per-event timestamp column, so oldest_ts is
+    # the previous pop's wall clock — a documented UPPER-bound watermark
+    # (no event in this batch can predate the last drain that emptied
+    # the ring region it came from)
+    pop_ts: float = 0.0
+    oldest_ts: float = 0.0
 
     @property
     def capacity(self) -> int:

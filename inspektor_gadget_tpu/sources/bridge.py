@@ -322,6 +322,10 @@ class NativeCapture:
         self._batch = EventBatch.alloc(batch_size)
         self._seq = 0
         self.kind = kind
+        # pipeline-health watermark: wall clock of the last pop that
+        # drained this source's ring — the folded path's oldest_ts
+        # upper bound (folded lanes carry no per-event timestamp)
+        self._last_pop_ts = 0.0
 
     def start(self) -> None:
         self._lib.ig_source_start(self._h)
@@ -369,6 +373,13 @@ class NativeCapture:
         b.seq = self._seq
         self._seq += int(got)
         b.drops = int(self._lib.ig_source_drops(self._h))
+        # batch-grain watermarks: one clock read + one vectorized min —
+        # the native ts column is CLOCK_REALTIME ns, comparable with
+        # time.time() epoch seconds
+        b.pop_ts = time.time()
+        b.oldest_ts = (float(c["ts"][: b.count].min()) / 1e9
+                       if b.count else b.pop_ts)
+        self._last_pop_ts = b.pop_ts
         return b
 
     def pop_folded(self, block: np.ndarray,
@@ -399,10 +410,14 @@ class NativeCapture:
                 _p32(block[0]), _p32(block[1]), _p32(block[2]))
         if got < 0:
             raise RuntimeError("pop_folded on destroyed source")
+        now = time.time()
         fb = FoldedBatch(lanes=block, count=int(got), seq=self._seq,
                          drops=int(self._lib.ig_source_drops(self._h)),
-                         has_values=with_values)
+                         has_values=with_values,
+                         pop_ts=now,
+                         oldest_ts=self._last_pop_ts or now)
         self._seq += int(got)
+        self._last_pop_ts = now
         return fb
 
     def generate(self, n: int) -> EventBatch:
@@ -422,6 +437,8 @@ class NativeCapture:
             self.kind, self.kind)
         b.cols["kind"][: b.count] = ev_kind
         b.cols["ts"][: b.count] = np.uint64(time.time_ns())
+        b.pop_ts = b.oldest_ts = time.time()
+        self._last_pop_ts = b.pop_ts
         return b
 
     def generate_folded(self, n: int, out: np.ndarray | None = None) -> np.ndarray:

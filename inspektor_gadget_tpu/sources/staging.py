@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import mmap
 import threading
+import time
 from typing import Any, Sequence
 
 import numpy as np
@@ -120,16 +121,27 @@ class H2DStager:
     """
 
     def __init__(self, pool: PinnedBufferPool, depth: int = 2,
-                 device: Any | None = None):
+                 device: Any | None = None, stats: Any | None = None):
         self.pool = pool
         self.depth = max(int(depth), 1)
         # multi-lane mode (ISSUE 14): pin transfers to one chip so lane
         # k+1's H2D overlaps lane k's compute; None keeps the default-
         # device placement (the single-chip path, unchanged)
         self.device = device
+        # pipeline health plane (telemetry/pipeline.py PipelineStats):
+        # the ring slot the next stage() lands on is a FREE diagnostic —
+        # empty means the device already drained everything in flight
+        # (host-bound: a starved tick), occupied means the host is a
+        # full ring depth ahead and must block (device-bound: a
+        # saturated tick, with the block_until_ready stall timed)
+        self.stats = stats
+        self._lane_i = int(pool.lane) if str(pool.lane).isdigit() else 0
         self._inflight = _tm_inflight.labels(lane=pool.lane)
         self._slots: list[tuple[np.ndarray, Any] | None] = [None] * self.depth
         self._i = 0
+
+    def _occupied(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
 
     def stage(self, block: np.ndarray,
               arrays: Sequence[np.ndarray]) -> tuple:
@@ -138,7 +150,15 @@ class H2DStager:
 
         old = self._slots[self._i]
         if old is not None:
-            self._retire(old)
+            if self.stats is not None:
+                t0 = time.perf_counter()
+                self._retire(old)
+                self.stats.note_saturated(time.perf_counter() - t0,
+                                          lane=self._lane_i)
+            else:
+                self._retire(old)
+        elif self.stats is not None:
+            self.stats.note_starved(lane=self._lane_i)
         if self.device is not None:
             devs = tuple(jax.device_put(a, self.device) for a in arrays)
         else:
@@ -147,6 +167,9 @@ class H2DStager:
         self._slots[self._i] = (block, devs)
         self.last_slot = self._i
         self._i = (self._i + 1) % self.depth
+        if self.stats is not None:
+            self.stats.note_occupancy("h2d", self._occupied(),
+                                      lane=self._lane_i)
         return devs
 
     def fence(self, token: Any) -> None:
@@ -179,3 +202,7 @@ class H2DStager:
             if slot is not None:
                 self._retire(slot)
                 self._slots[j] = None
+        if self.stats is not None:
+            # teardown accounting: the occupancy gauge must read 0 once
+            # every in-flight block is back in the pool
+            self.stats.note_occupancy("h2d", 0, lane=self._lane_i)

@@ -54,6 +54,10 @@ class PySyntheticSource:
         b.count = n
         b.seq = self._seq
         self._seq += n
+        # pipeline-health watermarks: synthesis IS the pop, so both
+        # stamps land on the same clock read (host lag 0 by definition —
+        # the device-lag watermark downstream stays meaningful)
+        b.pop_ts = b.oldest_ts = time.time()
         return b
 
     pop = generate

@@ -2,14 +2,19 @@
 
 The agent opts in with --metrics-addr (off by default — the reference's
 otel-metrics-listen-address contract): GET /metrics renders the process
-registry in text format 0.0.4, GET /healthz answers ok. ThreadingHTTPServer
-on a daemon thread; scrapes never touch the gRPC workers.
+registry in text format 0.0.4, GET /healthz answers a JSON liveness
+document (status/uptime/scrape count — what a probe or a human curl
+wants to know: is it up, since when, is anyone scraping it).
+ThreadingHTTPServer on a daemon thread; scrapes never touch the gRPC
+workers.
 """
 
 from __future__ import annotations
 
+import json
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .registry import REGISTRY, Registry
@@ -35,17 +40,28 @@ class MetricsServer:
         self.registry = registry if registry is not None else REGISTRY
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        self._started_at = 0.0
+        self.scrapes = 0  # /metrics GETs served since start()
 
     def start(self) -> "MetricsServer":
         registry = self.registry
+        server = self
+        self._started_at = time.monotonic()
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — stdlib handler contract
                 if self.path.split("?", 1)[0] == "/metrics":
+                    server.scrapes += 1
                     body = registry.render_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif self.path == "/healthz":
-                    body, ctype = b"ok\n", "text/plain"
+                elif self.path.split("?", 1)[0] == "/healthz":
+                    body = (json.dumps({
+                        "status": "ok",
+                        "uptime": round(
+                            time.monotonic() - server._started_at, 3),
+                        "scrapes": server.scrapes,
+                    }) + "\n").encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return

@@ -1,0 +1,248 @@
+"""Pipeline health plane: per-stage lag watermarks, backpressure and
+starvation accounting for the ingest hot path.
+
+The BENCH_r04 starvation gap (device plane eats 2.6B ev/s/chip, one host
+thread supplies 130M) was only visible in one-off `bench run` sessions;
+a live fleet had no per-stage lag, occupancy, or starvation signal at
+all. This module is the standing instrument: every tpusketch run (and
+the perf harness) registers a `PipelineStats`, the staging layer and the
+operator ingest loop feed it batch-grain observations, and every surface
+the fleet already looks at — harvest summaries, DumpState, Prometheus,
+doctor, `ig-tpu fleet lag`, the `pipeline_lag` alert kind — reads its
+`snapshot()`.
+
+Vocabulary (docs/observability.md "Pipeline health & backpressure"):
+
+- **Watermark**: each batch carries its oldest-event timestamp and its
+  pop timestamp (sources/batch.py `oldest_ts`/`pop_ts`, stamped once per
+  batch — zero per-event cost). Host lag = pop − oldest event; device
+  lag = dispatch − pop. The *watermark* of a stage is the lag of the
+  most recently dispatched batch.
+- **Starved tick**: the H2D stager found its next ring slot empty — the
+  device had already drained everything in flight; the host is the
+  bottleneck (the BENCH_r04 regime).
+- **Saturated tick**: the slot was still occupied — the host is a full
+  ring depth ahead and must block on `block_until_ready` (the stall
+  seconds are measured); the device is the bottleneck.
+- **starved_ratio** = starved / (starved + saturated).
+
+Lag *distributions* eat the quantile plane's own dogfood: each stage
+feeds a host-side DDSketch twin (`LagSketch`, same bucket math as
+`ops/quantiles.py`, pure numpy — this module must not import jax) so
+summaries carry p50/p99 lag per stage, not just the last watermark.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+import numpy as np
+
+from .registry import counter, gauge
+
+_tm_stage_lag = gauge(
+    "ig_pipeline_stage_lag_seconds",
+    "Lag watermark of the most recent batch through a pipeline stage",
+    ("stage", "lane"))
+_tm_starved_ratio = gauge(
+    "ig_pipeline_starved_ratio",
+    "starved / (starved + saturated) stager ticks — 1.0 means the device "
+    "always drained the ring before the host refilled it (host-bound)")
+_tm_backpressure = counter(
+    "ig_pipeline_backpressure_total",
+    "Ticks a pipeline stage blocked on a full downstream ring",
+    ("stage",))
+_tm_occupancy = gauge(
+    "ig_pipeline_occupancy",
+    "Occupied slots in a pipeline stage's ring",
+    ("stage", "lane"))
+
+
+class LagSketch:
+    """Host-twin DDSketch over a single stage's lag samples.
+
+    Same bucket geometry as ops/quantiles.py `dd_init` defaults (alpha
+    1%, 2048 buckets, min_value 1e-9 — spans ns..~30s), replicated in
+    scalar math because telemetry must stay importable without jax;
+    tests/test_pipeline_health.py pins parity against `dd_quantile_np`.
+    One sample per *batch*, so the per-add cost is a log and an int
+    increment, nothing per event.
+    """
+
+    __slots__ = ("alpha", "min_value", "counts", "zeros", "total",
+                 "watermark", "_inv_log_gamma", "_offset", "_gamma")
+
+    def __init__(self, alpha: float = 0.01, n_buckets: int = 2048,
+                 min_value: float = 1e-9):
+        self.alpha = alpha
+        self.min_value = min_value
+        self._gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._inv_log_gamma = 1.0 / math.log(self._gamma)
+        self._offset = math.log(min_value) * self._inv_log_gamma
+        self.counts = np.zeros(n_buckets, np.int64)
+        self.zeros = 0
+        self.total = 0
+        self.watermark = 0.0
+
+    def add(self, v: float) -> None:
+        self.watermark = float(v)
+        self.total += 1
+        if v <= 0.0:
+            self.zeros += 1
+            return
+        idx = math.ceil(math.log(max(v, self.min_value))
+                        * self._inv_log_gamma - self._offset)
+        self.counts[min(max(idx, 0), len(self.counts) - 1)] += 1
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile q — the dd_quantile_np formula on this
+        sketch's own lanes (0.0 inside the zero bucket / empty sketch:
+        a lag gauge must never surface NaN)."""
+        if self.total <= 0:
+            return 0.0
+        rank = q * max(self.total - 1.0, 0.0)
+        if rank < self.zeros:
+            return 0.0
+        cum = self.zeros + np.cumsum(self.counts.astype(np.float64))
+        bucket = int((cum <= rank).sum())
+        bucket = min(bucket, len(self.counts) - 1)
+        log_gamma = math.log(self._gamma)
+        offset = math.log(self.min_value) / log_gamma
+        return float(2.0 * math.exp((bucket + offset) * log_gamma)
+                     / (self._gamma + 1.0))
+
+
+class PipelineStats:
+    """Per-run pipeline health accounting, fed batch-grain from the
+    staging layer (starved/saturated/stall/occupancy) and the operator
+    ingest loop (watermarks) — registered like SketchStatsSource so live
+    surfaces (DumpState, doctor, fleet lag) can find it by run."""
+
+    def __init__(self, run_id: str, gadget: str = ""):
+        self.run_id = run_id
+        self.gadget = gadget
+        self._mu = threading.Lock()
+        self._stages: dict[tuple[str, int], LagSketch] = {}
+        self.starved = 0
+        self.saturated = 0
+        self.stall_s = 0.0
+        self.rounds = 0
+        self._backpressure: dict[str, int] = {}
+        self._occupancy: dict[str, float] = {}
+        self._occ_touched: set[tuple[str, str]] = set()
+
+    # -- observations (hot path: one lock + O(1) work per batch) ------------
+
+    def note_lag(self, stage: str, lag_s: float, lane: int = 0) -> None:
+        lag_s = max(float(lag_s), 0.0)
+        with self._mu:
+            sk = self._stages.get((stage, lane))
+            if sk is None:
+                sk = self._stages[(stage, lane)] = LagSketch()
+            sk.add(lag_s)
+        _tm_stage_lag.labels(stage=stage, lane=str(lane)).set(lag_s)
+
+    def note_host_lag(self, lag_s: float, lane: int = 0) -> None:
+        """pop − oldest event: how stale a batch already was when the
+        host popped it off the capture ring."""
+        self.note_lag("pop", lag_s, lane)
+
+    def note_device_lag(self, lag_s: float, lane: int = 0) -> None:
+        """dispatch − pop: how long a popped batch waited for staging +
+        the device update to pick it up."""
+        self.note_lag("h2d", lag_s, lane)
+
+    def note_starved(self, lane: int = 0) -> None:
+        with self._mu:
+            self.starved += 1
+            ratio = self.starved / (self.starved + self.saturated)
+        _tm_starved_ratio.set(ratio)
+
+    def note_saturated(self, stall_s: float, lane: int = 0,
+                       stage: str = "h2d") -> None:
+        with self._mu:
+            self.saturated += 1
+            self.stall_s += max(float(stall_s), 0.0)
+            self._backpressure[stage] = self._backpressure.get(stage, 0) + 1
+            ratio = self.starved / (self.starved + self.saturated)
+        _tm_starved_ratio.set(ratio)
+        _tm_backpressure.labels(stage=stage).inc()
+
+    def note_backpressure(self, stage: str, n: int = 1) -> None:
+        with self._mu:
+            self._backpressure[stage] = self._backpressure.get(stage, 0) + n
+        _tm_backpressure.labels(stage=stage).inc(n)
+
+    def note_occupancy(self, stage: str, occupied: float,
+                       lane: int = 0) -> None:
+        with self._mu:
+            self._occupancy[f"{stage}:{lane}"] = float(occupied)
+            self._occ_touched.add((stage, str(lane)))
+        _tm_occupancy.labels(stage=stage, lane=str(lane)).set(occupied)
+
+    def note_round(self) -> None:
+        with self._mu:
+            self.rounds += 1
+
+    # -- reads --------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The `pipeline` block harvest summaries / DumpState carry —
+        plain JSON-able dict, stable keys (alert summary_fields and the
+        fleet lag table key into it)."""
+        with self._mu:
+            stages: dict[str, dict] = {}
+            for (stage, lane), sk in sorted(self._stages.items()):
+                row = stages.setdefault(stage, {
+                    "watermark_s": 0.0, "p50_s": 0.0, "p99_s": 0.0,
+                    "count": 0})
+                # multi-lane stages report the worst lane's view: the
+                # fleet cares about the laggiest lane, not the average
+                row["watermark_s"] = max(row["watermark_s"], sk.watermark)
+                row["p50_s"] = max(row["p50_s"], sk.quantile(0.50))
+                row["p99_s"] = max(row["p99_s"], sk.quantile(0.99))
+                row["count"] += sk.total
+            ticks = self.starved + self.saturated
+            return {
+                "stages": stages,
+                "host_lag_s": stages.get("pop", {}).get("watermark_s", 0.0),
+                "device_lag_s": stages.get("h2d", {}).get("watermark_s", 0.0),
+                "starved": self.starved,
+                "saturated": self.saturated,
+                "starved_ratio": (self.starved / ticks) if ticks else 0.0,
+                "stall_s": self.stall_s,
+                "backpressure": dict(self._backpressure),
+                "occupancy": dict(self._occupancy),
+                "rounds": self.rounds,
+            }
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def register(self) -> None:
+        with _live_mu:
+            _live[self.run_id] = self
+
+    def unregister(self) -> None:
+        """Drop out of the live registry and return every gauge this run
+        touched exactly to baseline (the PR-9/PR-11 teardown-accounting
+        discipline: a stopped run leaves no residue on shared gauges)."""
+        with _live_mu:
+            _live.pop(self.run_id, None)
+        with self._mu:
+            touched = list(self._stages.keys())
+            occ = list(self._occ_touched)
+        for stage, lane in touched:
+            _tm_stage_lag.labels(stage=stage, lane=str(lane)).set(0.0)
+        for stage, lane in occ:
+            _tm_occupancy.labels(stage=stage, lane=lane).set(0.0)
+        _tm_starved_ratio.set(0.0)
+
+
+_live_mu = threading.Lock()
+_live: dict[str, PipelineStats] = {}
+
+
+def live_stats() -> list[PipelineStats]:
+    with _live_mu:
+        return list(_live.values())

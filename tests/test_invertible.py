@@ -52,6 +52,7 @@ def _release_instances():
         for st in getattr(inst, "_lane_stagers", []):
             st.drain()
         inst._stats.unregister()
+        inst._pstats.unregister()
 
 
 def _make_instance(extra_params: dict, node: str = "",

@@ -47,6 +47,7 @@ def _release_instances():
         for st in getattr(inst, "_lane_stagers", []):
             st.drain()
         inst._stats.unregister()
+        inst._pstats.unregister()
 
 
 @pytest.fixture()

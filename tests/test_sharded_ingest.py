@@ -42,6 +42,27 @@ KW = dict(depth=3, log2_width=9, hll_p=7, entropy_log2_width=6, k=64)
 BATCH = 512
 
 
+@pytest.fixture(autouse=True)
+def _release_instances():
+    """Instances built outside a real gadget run never see
+    post_gadget_run — drop them from the live table (checkpoint_all
+    iterates it) and drain their stagers (the h2d inflight gauge) so no
+    state leaks into other test files."""
+    from inspektor_gadget_tpu.operators import tpusketch
+    before = set(tpusketch._live)
+    yield
+    with tpusketch._live_mu:
+        fresh = [rid for rid in list(tpusketch._live) if rid not in before]
+        insts = [tpusketch._live.pop(rid) for rid in fresh]
+    for inst in insts:
+        if getattr(inst, "_stager", None) is not None:
+            inst._stager.drain()
+        for st in getattr(inst, "_lane_stagers", []):
+            st.drain()
+        inst._stats.unregister()
+        inst._pstats.unregister()
+
+
 def _assert_bundles_bit_identical(a: SketchBundle, b: SketchBundle,
                                   ctx: str = "") -> None:
     for name, xa, xb in (

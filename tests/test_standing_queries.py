@@ -74,6 +74,7 @@ def _release_instances():
         for st in getattr(inst, "_lane_stagers", []):
             st.drain()
         inst._stats.unregister()
+        inst._pstats.unregister()
     for rid, _ in live_engines():
         if rid not in before_q:
             queries_engine.unregister(rid)

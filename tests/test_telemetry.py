@@ -165,10 +165,35 @@ def test_metrics_http_endpoint():
         base = f"http://127.0.0.1:{srv.port}"
         body = urllib.request.urlopen(f"{base}/metrics", timeout=5).read()
         assert b"served_total 3" in body
-        assert urllib.request.urlopen(
-            f"{base}/healthz", timeout=5).read() == b"ok\n"
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        srv.stop()
+
+
+def test_healthz_endpoint_json():
+    """ISSUE 18 satellite: /healthz answers a JSON liveness doc — 200,
+    status ok, a monotonic uptime, and a scrape counter that tracks
+    /metrics GETs (so a probe can tell 'up but never scraped' from
+    'up and scraped')."""
+    import json as _json
+
+    srv = MetricsServer("127.0.0.1:0", registry=Registry()).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        resp = urllib.request.urlopen(f"{base}/healthz", timeout=5)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "application/json"
+        doc = _json.loads(resp.read())
+        assert doc["status"] == "ok"
+        assert doc["uptime"] >= 0.0
+        assert doc["scrapes"] == 0         # nothing scraped yet
+        urllib.request.urlopen(f"{base}/metrics", timeout=5).read()
+        urllib.request.urlopen(f"{base}/metrics?x=1", timeout=5).read()
+        doc2 = _json.loads(urllib.request.urlopen(
+            f"{base}/healthz?probe=1", timeout=5).read())
+        assert doc2["scrapes"] == 2
+        assert doc2["uptime"] >= doc["uptime"]
     finally:
         srv.stop()
 
@@ -832,3 +857,4 @@ def test_quantile_plane_counters_follow_value_lane():
             if getattr(inst, "_stager", None) is not None:
                 inst._stager.drain()
             inst._stats.unregister()
+            inst._pstats.unregister()

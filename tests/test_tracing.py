@@ -202,6 +202,38 @@ def test_chrome_trace_export_schema(agent_node):
     assert any(m["name"] == "process_name" for m in meta)
 
 
+def test_chrome_trace_contains_pipeline_stage_spans(agent_node):
+    """Pipeline health plane (ISSUE 18): each harvest tick renders one
+    span per instrumented stage, with the watermark/quantile accounting
+    in the span args and the run's trace ID threaded through — so
+    `debug trace export` shows a real pipeline timeline."""
+    tid = _run_traced(agent_node)
+    time.sleep(0.3)
+    recs = TRACER.records(trace_id=tid)
+    stage = [r for r in recs if r.name.startswith("tpusketch/stage/")]
+    names = {r.name for r in stage}
+    assert {"tpusketch/stage/pop", "tpusketch/stage/h2d"} <= names, names
+    pop = next(r for r in stage if r.name == "tpusketch/stage/pop")
+    assert {"watermark_s", "p50_s", "p99_s", "count"} <= set(pop.attrs)
+    assert pop.attrs["count"] > 0
+    # ring warmup guarantees starved ticks, so the stager span rendered
+    stager = next(r for r in stage if r.name == "tpusketch/stage/stager")
+    assert stager.attrs["starved"] > 0
+    assert 0.0 < stager.attrs["starved_ratio"] <= 1.0
+    # stage spans parent under the harvest span of the same trace
+    by_id = {r.span_id: r for r in recs}
+    assert by_id[pop.parent_id].name == "tpusketch/harvest"
+    # and they survive the Chrome export with identity + accounting args
+    doc = export_chrome(recs, trace_id=tid)
+    spans = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e["name"].startswith("tpusketch/stage/")]
+    assert spans
+    for e in spans:
+        assert e["args"]["trace_id"] == tid
+    pe = next(e for e in spans if e["name"] == "tpusketch/stage/pop")
+    assert pe["args"]["count"] > 0 and "watermark_s" in pe["args"]
+
+
 def test_flight_record_over_dump_state_rpc(agent_node):
     _run_traced(agent_node)
     client = AgentClient(next(iter(agent_node.values())), "trace-node")

@@ -56,6 +56,19 @@ CLAIM_RE = re.compile(
     r"\s*(?:ev|events)\s*/\s*s(?:ec)?\b",
     re.UNICODE)
 
+# pipeline-health claims (ISSUE 18): "~100% starved" / "starved 97%" must
+# be backed by a ledger record's extra.starved_fraction (stored 0..1,
+# compared as percent) — the starvation gap gets the same no-drift rule
+# as throughput
+STARVED_RE = re.compile(
+    r"(?P<prefix>[~≥≤<>=]\s*)?"
+    r"(?P<num>\d+(?:\.\d+)?)"
+    r"(?:\s*[–-]\s*(?P<num2>\d+(?:\.\d+)?))?"
+    r"\s*%\s*starved"
+    r"|starved\s*(?P<prefix_b>[~≥≤<>=]\s*)?"
+    r"(?P<num_b>\d+(?:\.\d+)?)\s*%",
+    re.IGNORECASE | re.UNICODE)
+
 
 @dataclasses.dataclass
 class Claim:
@@ -67,6 +80,7 @@ class Claim:
     hi: float
     approx: bool
     skipped: str = ""  # non-empty: why this claim is not enforced
+    kind: str = "ev_per_s"  # ev_per_s | starved_pct
 
 
 @dataclasses.dataclass
@@ -75,11 +89,20 @@ class Backing:
     platform: str      # tpu | cpu | gpu | none | unknown
     degraded: bool
     source: str
+    kind: str = "ev_per_s"
 
     @property
     def second_class(self) -> bool:
         """True when citing this entry requires the doc to say so."""
         return self.degraded or self.platform == "cpu"
+
+
+def _classify(claim: Claim, prefix: str, lower: str) -> Claim:
+    if prefix and prefix != "~":
+        claim.skipped = f"target ({prefix})"
+    elif any(w in lower for w in WAIVER_WORDS):
+        claim.skipped = "explicitly labeled unrecorded/unverified"
+    return claim
 
 
 def extract_claims(text: str, path: str) -> list[Claim]:
@@ -93,13 +116,22 @@ def extract_claims(text: str, path: str) -> list[Claim]:
             hi = (float(m.group("num2")) * scale if m.group("num2")
                   else lo)
             lo, hi = min(lo, hi), max(lo, hi)
-            claim = Claim(path=path, lineno=lineno, text=m.group(0),
-                          line=line, lo=lo, hi=hi, approx=prefix == "~")
-            if prefix and prefix != "~":
-                claim.skipped = f"target ({prefix})"
-            elif any(w in lower for w in WAIVER_WORDS):
-                claim.skipped = "explicitly labeled unrecorded/unverified"
-            out.append(claim)
+            out.append(_classify(
+                Claim(path=path, lineno=lineno, text=m.group(0),
+                      line=line, lo=lo, hi=hi, approx=prefix == "~"),
+                prefix, lower))
+        for m in STARVED_RE.finditer(line):
+            prefix = (m.group("prefix") or m.group("prefix_b")
+                      or "").strip()
+            num = m.group("num") or m.group("num_b")
+            lo = float(num)
+            hi = float(m.group("num2")) if m.group("num2") else lo
+            lo, hi = min(lo, hi), max(lo, hi)
+            out.append(_classify(
+                Claim(path=path, lineno=lineno, text=m.group(0),
+                      line=line, lo=lo, hi=hi, approx=prefix == "~",
+                      kind="starved_pct"),
+                prefix, lower))
     return out
 
 
@@ -147,6 +179,11 @@ def _ledger_backings(path: pathlib.Path) -> list[Backing]:
             if k.endswith("_ev_per_s") and isinstance(v, (int, float)):
                 out.append(Backing(float(v), platform, degraded,
                                    f"{src}#{k}"))
+        sf = (rec.get("extra") or {}).get("starved_fraction")
+        if isinstance(sf, (int, float)):
+            out.append(Backing(float(sf) * 100.0, platform, degraded,
+                               f"{src}#starved_fraction",
+                               kind="starved_pct"))
     return out
 
 
@@ -163,6 +200,8 @@ def collect_backings(root: pathlib.Path) -> list[Backing]:
 
 
 def _matches(claim: Claim, b: Backing) -> bool:
+    if b.kind != claim.kind:
+        return False
     tol = TOL_APPROX if claim.approx else TOL
     return claim.lo * (1 - tol) <= b.value <= claim.hi * (1 + tol)
 
@@ -173,7 +212,8 @@ def check_claim(claim: Claim, backings: list[Backing]) -> str:
         return ""
     hits = [b for b in backings if _matches(claim, b)]
     if not hits:
-        near = min(backings, key=lambda b: abs(b.value - claim.lo),
+        near = min((b for b in backings if b.kind == claim.kind),
+                   key=lambda b: abs(b.value - claim.lo),
                    default=None)
         hint = (f" (nearest artifact value: {near.value:,.0f} from "
                 f"{near.source})" if near else " (no artifacts at all)")
