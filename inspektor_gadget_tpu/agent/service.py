@@ -1750,6 +1750,17 @@ class AgentServer:
                          **ps.snapshot()} for ps in pipeline_stats()]
         except Exception as e:  # noqa: BLE001 — debug dump stays best-effort
             pipeline = [{"error": repr(e)}]
+        # accuracy audit plane (ISSUE 19): one row per audited run —
+        # per-stat analytic bound vs observed error, sample size, drift
+        # ratio — so `ig-tpu fleet accuracy` and the doctor accuracy row
+        # read the envelope without a dedicated RPC
+        accuracy: list = []
+        try:
+            from ..ops.accuracy import live_stats as accuracy_stats
+            accuracy = [{"run_id": a.run_id, "gadget": a.gadget,
+                         **a.snapshot()} for a in accuracy_stats()]
+        except Exception as e:  # noqa: BLE001 — debug dump stays best-effort
+            accuracy = [{"error": repr(e)}]
         # the node's alert table rides the same debug dump, so a remote
         # `ig-tpu alerts list` can read every agent's active alerts
         from ..alerts import ACTIVE as active_alerts
@@ -1760,6 +1771,7 @@ class AgentServer:
                "history_tiers": history_tiers,
                "standing_queries": standing_queries,
                "pipeline": pipeline,
+               "accuracy": accuracy,
                # CRD-path state rides the same debug dump (the reference's
                # daemon dumps its trace list alongside containers)
                "traces": [{"name": t["metadata"]["name"],

@@ -126,10 +126,10 @@ def encode_summary(summary) -> tuple[dict, bytes]:
         rows = getattr(summary, field, None)
         if rows:
             header[field] = [[int(k), int(c)] for k, c in rows[:cap]]
-    # the quantile block (ISSUE 16) and pipeline health block (ISSUE 18)
-    # ride the same only-when-present rule: plane-off summaries keep
-    # byte-identical headers
-    for field in ("inv", "classes", "quantiles", "pipeline"):
+    # the quantile block (ISSUE 16), pipeline health block (ISSUE 18)
+    # and accuracy block (ISSUE 19) ride the same only-when-present
+    # rule: plane-off summaries keep byte-identical headers
+    for field in ("inv", "classes", "quantiles", "pipeline", "accuracy"):
         v = getattr(summary, field, None)
         if v is not None:
             header[field] = v

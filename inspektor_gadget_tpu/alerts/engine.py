@@ -211,6 +211,17 @@ class AlertEngine:
             if v > 0.0:
                 base.append(v)
             return [("", v, trig)]
+        if rule.kind == "accuracy_drift":
+            # accuracy audit plane (ISSUE 19): the ANALYTIC bound is the
+            # baseline — no rolling window. Fires when the worst
+            # observed_err/bound ratio exceeds `factor` (and the optional
+            # absolute floor). 0.0 means nothing was audited (plane off,
+            # idle window, empty sample): "no observation" neither
+            # triggers nor counts as recovery data — the quantile_shift
+            # idle-window immunity
+            v = fields["accuracy_ratio"]
+            trig = v > 0.0 and v > rule.factor and v >= rule.threshold
+            return [("", v, trig)]
         if rule.kind == "heavy_hitter_churn":
             hh = (summary.get("heavy_hitters") if isinstance(summary, dict)
                   else summary.heavy_hitters) or []

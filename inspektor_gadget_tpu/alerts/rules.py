@@ -46,7 +46,7 @@ from ..params.validators import parse_duration
 
 KINDS = ("threshold", "ratio", "entropy_jump", "cardinality_spike",
          "heavy_hitter_churn", "anomaly_score", "heavy_flow",
-         "quantile_shift", "pipeline_lag")
+         "quantile_shift", "pipeline_lag", "accuracy_drift")
 SEVERITIES = ("info", "warning", "critical")
 OPS = (">", ">=", "<", "<=")
 
@@ -97,8 +97,10 @@ def summary_fields(summary) -> dict[str, float]:
         quantiles = getattr(summary, "quantiles", None) or {}
     if isinstance(summary, dict):
         pipeline = summary.get("pipeline") or {}
+        accuracy = summary.get("accuracy") or {}
     else:
         pipeline = getattr(summary, "pipeline", None) or {}
+        accuracy = getattr(summary, "accuracy", None) or {}
     top_count = float(hh[0][1]) if hh else 0.0
     return {
         "events": events,
@@ -119,6 +121,11 @@ def summary_fields(summary) -> dict[str, float]:
         "host_lag": float(pipeline.get("host_lag_s", 0.0)),
         "device_lag": float(pipeline.get("device_lag_s", 0.0)),
         "starved_ratio": float(pipeline.get("starved_ratio", 0.0)),
+        # accuracy audit plane (ISSUE 19): worst observed_err / analytic
+        # bound across audited stats. 0.0 when the plane is off or
+        # nothing was audited — accuracy_drift reads 0 as "no
+        # observation" (idle-window immunity), never as zero error
+        "accuracy_ratio": float(accuracy.get("ratio", 0.0)),
     }
 
 
@@ -162,6 +169,9 @@ class AlertRule:
         elif self.kind == "pipeline_lag":
             cond = (f"{self.field} > {self.factor:g}x mean(last "
                     f"{self.window}) (pipeline health plane)")
+        elif self.kind == "accuracy_drift":
+            cond = (f"observed_err > {self.factor:g}x analytic bound "
+                    "(accuracy audit plane)")
         else:  # anomaly_score
             cond = f"anomaly[mntns] {self.op} {self.threshold:g}"
         return (f"{self.id}: {cond} for {self.for_s:g}s "
@@ -253,6 +263,13 @@ def _parse_rule(raw: object, index: int) -> AlertRule:
                 f"rule {rid!r}: pipeline_lag watches one of "
                 f"{list(PIPELINE_FIELDS)} (the harvest pipeline block), "
                 f"got field={field!r}")
+    elif kind == "accuracy_drift":
+        if field and field != "accuracy_ratio":
+            raise RuleError(
+                f"rule {rid!r}: kind 'accuracy_drift' always evaluates "
+                f"the worst observed_err/bound ratio; remove "
+                f"field={field!r}")
+        field = "accuracy_ratio"
 
     denom = raw.get("denom", "")
     if kind == "ratio":
@@ -264,12 +281,14 @@ def _parse_rule(raw: object, index: int) -> AlertRule:
     elif denom:
         raise RuleError(f"rule {rid!r}: 'denom' only applies to kind 'ratio'")
 
-    # cardinality_spike / quantile_shift / pipeline_lag trigger on
-    # `factor` x baseline; their threshold is an optional absolute
+    # cardinality_spike / quantile_shift / pipeline_lag / accuracy_drift
+    # trigger on `factor` x baseline (for accuracy_drift the analytic
+    # bound IS the baseline); their threshold is an optional absolute
     # floor. Every other kind requires one.
     if "threshold" not in raw and kind not in ("cardinality_spike",
                                                "quantile_shift",
-                                               "pipeline_lag"):
+                                               "pipeline_lag",
+                                               "accuracy_drift"):
         raise RuleError(f"rule {rid!r}: missing 'threshold'")
     threshold = _num(raw, "threshold", rid, 0.0)
     clear = None

@@ -82,6 +82,19 @@ def add_fleet_parser(sub) -> None:
     lp.add_argument("-o", "--output", default="table",
                     choices=["table", "json"])
     lp.set_defaults(func=cmd_fleet_lag)
+    ap = fsub.add_parser(
+        "accuracy", help="per-node sketch accuracy audit: per-stat "
+        "analytic bound vs observed error (shadow-sample ground truth), "
+        "audit sample sizes, drift ratio")
+    ap.add_argument("--remote", default="",
+                    help="name=target[,...]; defaults to the local fleet")
+    ap.add_argument("--deadline", type=float, default=3.0,
+                    help="per-agent RPC deadline in seconds")
+    ap.add_argument("--gadget", default="",
+                    help="restrict to one gadget (category/name)")
+    ap.add_argument("-o", "--output", default="table",
+                    choices=["table", "json"])
+    ap.set_defaults(func=cmd_fleet_accuracy)
 
 
 def _probe_agent(node: str, target: str, deadline: float) -> dict:
@@ -362,6 +375,64 @@ def _print_lag_table(per_node: list[dict], prev: dict, dt: float) -> dict:
                       f"{_fmt_lag(srow.get('p99_s', 0.0)):>9s} "
                       f"{o:>4.0f} {starved:>8s}")
     return counts
+
+
+def cmd_fleet_accuracy(args) -> int:
+    """Operator view of the accuracy audit plane (ISSUE 19): one row per
+    (node, run, stat) with the analytic error bound, the observed error
+    vs the shadow-sample ground truth, and whether the stat was audited
+    at all — the fleet-wide answer to "can I trust these numbers"."""
+    from ..agent.client import AgentClient
+    targets = _resolve_targets(args)
+    if targets is None:
+        return 2
+    if not targets:
+        print("no agents (use deploy --local N or --remote)",
+              file=sys.stderr)
+        return 2
+    per_node: list[dict] = []
+    for node, target in targets.items():
+        row: dict = {"node": node, "target": target, "runs": [],
+                     "error": ""}
+        client = None
+        try:
+            client = AgentClient(target, node, rpc_deadline=args.deadline)
+            runs = client.dump_state().get("accuracy") or []
+            runs = [r for r in runs if "error" not in r]
+            if args.gadget:
+                runs = [r for r in runs if r.get("gadget") == args.gadget]
+            row["runs"] = runs
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            row["error"] = str(e)
+        finally:
+            if client is not None:
+                client.close()
+        per_node.append(row)
+    if args.output == "json":
+        print(json.dumps({"agents": per_node}, indent=2, default=str))
+        return 0 if not any(r["error"] for r in per_node) else 1
+    print(f"{'NODE':<12s} {'RUN':<14s} {'STAT':<14s} {'BOUND':>10s} "
+          f"{'OBSERVED':>10s} {'AUDITED':>7s} {'SAMPLE':>7s} "
+          f"{'RATIO':>6s}")
+    for r in per_node:
+        if r["error"]:
+            print(f"{r['node']:<12s} unreachable: {r['error']}")
+            continue
+        if not r["runs"]:
+            print(f"{r['node']:<12s} no audited runs (audit-sample 0?)")
+            continue
+        for run in r["runs"]:
+            rid = str(run.get("run_id", ""))[:14]
+            sample = run.get("sample_size", 0)
+            ratio = f"{run.get('ratio', 0.0):.2f}"
+            for stat, srow in sorted((run.get("stats") or {}).items()):
+                obs = srow.get("observed_err")
+                print(f"{r['node']:<12s} {rid:<14s} {stat:<14s} "
+                      f"{srow.get('bound', 0.0):>10.5f} "
+                      f"{(f'{obs:.5f}' if obs is not None else '-'):>10s} "
+                      f"{('yes' if srow.get('audited') else 'no'):>7s} "
+                      f"{sample:>7d} {ratio:>6s}")
+    return 0 if not any(r["error"] for r in per_node) else 1
 
 
 def cmd_fleet_lag(args) -> int:

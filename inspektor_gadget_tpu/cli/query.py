@@ -146,6 +146,25 @@ def _print_quantiles(answer) -> None:
         print(line)
 
 
+def _print_accuracy_audit(acc: dict) -> None:
+    """Observed-error lines when the range was audited (windows carried
+    the shadow sample): `stat  observed vs ±bound` per audited stat."""
+    if not acc.get("audited"):
+        return
+    print(f"accuracy audit (shadow sample, {acc.get('sample_size', 0)} "
+          f"key(s) of {acc.get('sample_capacity', 0)}):")
+    for stat, row in sorted((acc.get("stats") or {}).items()):
+        if not row.get("audited") or row.get("observed_err") is None:
+            continue
+        obs, bound = float(row["observed_err"]), row.get("bound")
+        line = f"  {stat:<16s} observed err {obs:.5f}"
+        if bound:
+            line += f" vs bound {float(bound):.5f}"
+        if stat == "heavy_hitters" and row.get("audited_keys"):
+            line += f" ({row['audited_keys']} key(s) audited)"
+        print(line)
+
+
 def _print_answer(answer, *, key: str | None, show_slices: bool,
                   top: int, quantiles: bool = False) -> None:
     nodes = ",".join(answer.nodes) or "local"
@@ -166,13 +185,36 @@ def _print_answer(answer, *, key: str | None, show_slices: bool,
     if fallback:
         print(f"note: node(s) {', '.join(fallback)} answered via "
               "list+fetch fallback (pre-pushdown agent)")
-    print(f"events={answer.events:,} drops={answer.drops} "
-          f"distinct≈{answer.distinct:,.0f} "
-          f"entropy={answer.entropy_bits:.2f}b")
+    # error envelopes (accuracy audit plane): analytic bounds ride every
+    # answer; ± annotations draw from them inline
+    acc = answer.accuracy or {}
+    astats = acc.get("stats") or {}
+    d_bound = (astats.get("distinct") or {}).get("bound")
+    e_bound = (astats.get("entropy") or {}).get("bound")
+    line = (f"events={answer.events:,} drops={answer.drops} "
+            f"distinct≈{answer.distinct:,.0f}")
+    if d_bound is not None:
+        line += f" (±{d_bound * 100:.2f}%)"
+    line += f" entropy={answer.entropy_bits:.2f}b"
+    if e_bound is not None:
+        line += f" (bias ≤{e_bound:.3f}b)"
+    print(line)
+    if answer.approx:
+        # the seal-boundary taint (ISSUE 19 satellite): at least one
+        # consulted window's top-k candidate population exceeded k
+        print("note: heavy-hitter ranks are approximate — a consulted "
+              "window overflowed its top-k candidate ring")
     if answer.heavy_hitters:
-        print("heavy hitters:")
+        hh_env = astats.get("heavy_hitters") or {}
+        hdr = "heavy hitters"
+        if hh_env.get("bound_abs") is not None:
+            hdr += (f" (overestimate ≤ {hh_env['bound_abs']:,.0f} per "
+                    f"count @ {hh_env.get('confidence', 0.0):.0%} "
+                    f"confidence)")
+        print(hdr + ":")
         for k32, count, label in answer.heavy_hitters[:top]:
             print(f"  {label:<24s}  {count:>12,}")
+    _print_accuracy_audit(acc)
     if answer.heavy_flows:
         inv = answer.inv or {}
         cov = ("complete" if inv.get("complete")

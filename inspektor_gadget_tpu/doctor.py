@@ -564,6 +564,34 @@ def _probe_pipeline_health() -> Window:
         return Window("pipeline_health", False, repr(e))
 
 
+def _probe_accuracy() -> Window:
+    """Accuracy-audit-plane row (ISSUE 19): which gadget runs in this
+    process carry a live shadow-sample audit, their sample fill, and the
+    worst observed-error/analytic-bound ratio (> 1.0 means an estimate
+    drifted past its envelope — the accuracy_drift alert's trigger).
+    No audited runs is fine — the plane is opt-in (audit-sample > 0);
+    analytic bounds still ride every answer. The row fails only when
+    reading the registry breaks (`ig-tpu fleet accuracy` has detail)."""
+    try:
+        from .ops.accuracy import live_stats
+        rows = live_stats()
+        if not rows:
+            return Window("accuracy", True,
+                          "no audited runs (audit plane is opt-in: "
+                          "audit-sample > 0; analytic bounds always ride "
+                          "answers)")
+        per_run = []
+        for a in rows:
+            snap = a.snapshot()
+            per_run.append(
+                f"{a.run_id[:8]}: sample {snap['sample_size']}, "
+                f"fed {snap['samples_fed']}, ratio {snap['ratio']:.2f}")
+        return Window("accuracy", True,
+                      f"{len(rows)} audited run(s) — " + ", ".join(per_run))
+    except Exception as e:  # noqa: BLE001
+        return Window("accuracy", False, repr(e))
+
+
 def _probe_mountinfo() -> Window:
     try:
         with open("/proc/self/mountinfo") as f:
@@ -592,7 +620,7 @@ _PROBES = (
     _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
     _probe_history_dir, _probe_history_tiers, _probe_standing_queries,
     _probe_fleet_health, _probe_shared_runs, _probe_device_topology,
-    _probe_pipeline_health,
+    _probe_pipeline_health, _probe_accuracy,
 )
 
 

@@ -98,6 +98,14 @@ class QueryAnswer:
     # windows don't (all) carry the plane with one bucket geometry
     quantiles: dict | None = None
     histogram: list[int] | None = None
+    # accuracy audit plane (ISSUE 19): the per-stat error envelope —
+    # analytic bounds ALWAYS (derived client-side from the merged
+    # geometry + observed mass, so even plane-off history answers carry
+    # them), observed error only when every consulted window carried the
+    # shadow sample. `approx` is the candidate-overflow taint: True
+    # when ANY consulted window overflowed its top-k candidate ring.
+    accuracy: dict | None = None
+    approx: bool = False
 
     def compacted_windows(self) -> int:
         """How many folded windows were coarser than native resolution."""
@@ -131,6 +139,8 @@ class QueryAnswer:
             "levels": {str(k): v for k, v in sorted(self.levels.items())},
             "compacted_windows": self.compacted_windows(),
             "paths": dict(self.paths),
+            "accuracy": self.accuracy,
+            "approx": self.approx,
         }
 
 
@@ -254,6 +264,8 @@ def answer_query(windows: Iterable[SealedWindow], *,
         inv=inv_info,
         quantiles=qt_out,
         histogram=(hist.tolist() if hist is not None else None),
+        accuracy=merged.accuracy(heavy=[(k, c) for k, c, _ in hh]),
+        approx=bool(merged.approx),
     )
 
 

@@ -175,6 +175,21 @@ class SealedWindow:
     qt_total: int = 0
     qt_alpha: float = 0.01
     qt_min_value: float = 1.0
+    # -- accuracy audit plane (ISSUE 19) ----------------------------------
+    # `approx` is the TopK candidate-ring overflow flag, finally carried
+    # past the seal boundary (it used to be dropped here — the satellite
+    # bugfix): True means some window of this state overflowed its
+    # candidate ring, so merged top-k answers are approximate. It enters
+    # the digest only when True, keeping every pre-existing digest
+    # byte-identical. rs_keys/rs_weights are the per-window deterministic
+    # bottom-k shadow-sample delta (ops/accuracy.ShadowSample lanes;
+    # priorities recompute from keys, so they are never persisted):
+    # None = plane off (absent from digest/encoding), empty = plane on
+    # but nothing sampled this window.
+    approx: bool = False
+    rs_keys: np.ndarray | None = None
+    rs_weights: np.ndarray | None = None
+    rs_capacity: int = 0
 
     @property
     def slice_keys(self) -> list[str]:
@@ -216,6 +231,14 @@ def window_digest(win: SealedWindow) -> str:
             "qt_alpha": float(win.qt_alpha),
             "qt_min_value": float(win.qt_min_value)}
            if win.qt_counts is not None else {}),
+        # accuracy plane: approx enters only when True and the shadow
+        # lanes only when the audit plane sealed them — plane-off (and
+        # all pre-ISSUE-19) digests are byte-identical
+        **({"approx": True} if win.approx else {}),
+        **({"rs_keys": arr(win.rs_keys),
+            "rs_weights": arr(win.rs_weights),
+            "rs_capacity": int(win.rs_capacity)}
+           if win.rs_keys is not None else {}),
         "cms": arr(win.cms),
         "hll": arr(win.hll),
         "ent": arr(win.ent),
@@ -252,6 +275,9 @@ def encode_window(win: SealedWindow) -> tuple[dict, bytes]:
         arrays["inv_fpsum"] = win.inv_fpsum
     if win.qt_counts is not None:
         arrays["qt_counts"] = win.qt_counts
+    if win.rs_keys is not None:
+        arrays["rs_keys"] = win.rs_keys
+        arrays["rs_weights"] = win.rs_weights
     skeys = win.slice_keys
     if skeys:
         arrays["slice_events"] = np.array(
@@ -299,6 +325,12 @@ def encode_window(win: SealedWindow) -> tuple[dict, bytes]:
         header["qt_total"] = int(win.qt_total)
         header["qt_alpha"] = float(win.qt_alpha)
         header["qt_min_value"] = float(win.qt_min_value)
+    # accuracy plane headers ride only when carried, so plane-off wire
+    # bytes (and the approx-false common case) are unchanged
+    if win.approx:
+        header["approx"] = True
+    if win.rs_keys is not None:
+        header["rs_capacity"] = int(win.rs_capacity)
     return header, buf.getvalue()
 
 
@@ -346,6 +378,10 @@ def decode_window(header: dict, payload: bytes) -> SealedWindow:
         qt_total=int(header.get("qt_total", 0)),
         qt_alpha=float(header.get("qt_alpha", 0.01)),
         qt_min_value=float(header.get("qt_min_value", 1.0)),
+        approx=bool(header.get("approx", False)),
+        rs_keys=arrays.get("rs_keys"),
+        rs_weights=arrays.get("rs_weights"),
+        rs_capacity=int(header.get("rs_capacity", 0)),
     )
 
 
@@ -409,6 +445,37 @@ class MergedWindows:
     qt_total: int = 0
     qt_alpha: float = 0.01
     qt_min_value: float = 1.0
+    # accuracy plane: approx ORs over every consulted window (ANY
+    # overflowed window taints the merged top-k — no coverage rule can
+    # un-taint it); the shadow sample folds under the qt total-coverage
+    # rule (merge is exact only while every window carries a matching
+    # capacity)
+    approx: bool = False
+    rs: "object | None" = None       # ops.accuracy.ShadowSample
+
+    def accuracy(self, heavy: list[tuple[int, int]] | None = None) -> dict | None:
+        """The accuracy block for this merged range: analytic envelopes
+        always (geometry is read off the merged arrays), observed error
+        when the shadow plane folded with total coverage. None only for
+        an empty merge (no geometry to derive bounds from)."""
+        if self.cms is None or self.windows <= 0:
+            return None
+        from ..ops.accuracy import accuracy_block
+        depth, width = self.cms.shape
+        hh = heavy if heavy is not None else self.heavy_hitters(20)
+        return accuracy_block(
+            events=float(self.events),
+            depth=int(depth), width=int(width),
+            hll_p=int(np.log2(max(self.hll.shape[0], 2))),
+            ent_log2_width=int(np.log2(max(self.ent.shape[0], 2))),
+            distinct=self.distinct(),
+            entropy_bits=self.entropy_bits(),
+            hh_keys=np.array([k for k, _ in hh], np.uint32),
+            hh_counts=np.array([c for _, c in hh], np.int64),
+            qt_alpha=(float(self.qt_alpha) if self.qt_counts is not None
+                      else None),
+            shadow=self.rs,
+        )
 
     def quantile(self, q) -> float | np.ndarray:
         """Value at quantile q over the merged range (<= alpha relative
@@ -513,11 +580,16 @@ def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
                         candidates={}, slices={}, names={}, skipped=[])
     inv_dropped = False
     qt_dropped = False
+    rs_dropped = False
 
     def qt_matches(win: SealedWindow) -> bool:
         return (win.qt_counts.shape == out.qt_counts.shape
                 and float(win.qt_alpha) == float(out.qt_alpha)
                 and float(win.qt_min_value) == float(out.qt_min_value))
+
+    def rs_of(win: SealedWindow):
+        from ..ops.accuracy import ShadowSample
+        return ShadowSample(win.rs_capacity, win.rs_keys, win.rs_weights)
 
     for win in windows:
         if out.cms is not None and (
@@ -544,6 +616,8 @@ def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
                 out.qt_total = int(win.qt_total)
                 out.qt_alpha = float(win.qt_alpha)
                 out.qt_min_value = float(win.qt_min_value)
+            if win.rs_keys is not None:
+                out.rs = rs_of(win)
         else:
             out.cms += win.cms.astype(np.int64)
             np.maximum(out.hll, win.hll, out=out.hll)
@@ -619,6 +693,41 @@ def merge_windows(windows: Iterable[SealedWindow]) -> MergedWindows:
                     f"{win.node}/{win.gadget} window {win.window}: "
                     "quantile plane present but an earlier window lacked "
                     "it — latency quantiles disabled for this range")
+        # shadow-sample plane: the qt total-coverage rule — a ground
+        # truth over part of the range must not audit answers over all
+        # of it, so one window without the plane (or with a different
+        # capacity) drops the observed-error audit WITH a note; the
+        # analytic envelopes survive regardless (geometry still merges)
+        if out.windows > 0:
+            if win.rs_keys is None:
+                if out.rs is not None and not rs_dropped:
+                    rs_dropped = True
+                    out.skipped.append(
+                        f"{win.node}/{win.gadget} window {win.window}: no "
+                        "shadow sample — observed-error audit disabled "
+                        "for this range (partial ground truth would lie)")
+                out.rs = None
+            elif out.rs is not None:
+                if int(win.rs_capacity) != int(out.rs.capacity):
+                    rs_dropped = True
+                    out.skipped.append(
+                        f"{win.node}/{win.gadget} window {win.window}: "
+                        f"shadow capacity {win.rs_capacity} differs from "
+                        f"the merge base {out.rs.capacity} — "
+                        "observed-error audit disabled for this range")
+                    out.rs = None
+                else:
+                    out.rs = out.rs.merge(rs_of(win))
+            elif not rs_dropped:
+                rs_dropped = True
+                out.skipped.append(
+                    f"{win.node}/{win.gadget} window {win.window}: "
+                    "shadow sample present but an earlier window lacked "
+                    "it — observed-error audit disabled for this range")
+        # candidate-overflow taint ORs unconditionally: one overflowed
+        # window makes the merged top-k approximate no matter how many
+        # clean windows join it (the seal-boundary bugfix)
+        out.approx = out.approx or bool(win.approx)
         out.windows += 1
         if win.node and win.node not in out.nodes:
             out.nodes.append(win.node)
@@ -725,6 +834,15 @@ def merged_to_sealed(merged: MergedWindows, *, gadget: str, node: str,
         qt_total=int(merged.qt_total),
         qt_alpha=float(merged.qt_alpha),
         qt_min_value=float(merged.qt_min_value),
+        # accuracy plane survives re-sealing (compaction, pushdown,
+        # standing-query folds): the taint flag rides through, and the
+        # merged shadow — itself bit-identical to a single-pass sample
+        # of the union stream — re-seals as this window's lanes
+        approx=bool(merged.approx),
+        rs_keys=(merged.rs.keys if merged.rs is not None else None),
+        rs_weights=(merged.rs.weights if merged.rs is not None else None),
+        rs_capacity=(int(merged.rs.capacity) if merged.rs is not None
+                     else 0),
     )
     win.digest = window_digest(win)
     return win

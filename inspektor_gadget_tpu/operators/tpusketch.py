@@ -227,6 +227,12 @@ class SketchSummary:
     # summary digests (capture/journal.py whitelist), encoded on the
     # wire only when present — pre-plane headers stay byte-identical
     pipeline: dict | None = None
+    # accuracy audit plane (ISSUE 19): ops.accuracy.accuracy_block at
+    # harvest time — per-stat analytic bounds + observed error vs the
+    # shadow-sample ground truth. Only-when-present on the wire and
+    # excluded from summary digests, same as `pipeline`; None when the
+    # audit plane is off
+    accuracy: dict | None = None
     # flat numeric access for detector rules lives in ONE place:
     # alerts.rules.summary_fields (handles this dataclass and the
     # wire-decoded dict shape alike)
@@ -373,6 +379,18 @@ class TpuSketch(Operator):
                                   "latency ns / byte counts for the "
                                   "value-bearing kinds; folded batches "
                                   "carry their own lane)"),
+            # accuracy audit plane (ISSUE 19): a host-side deterministic
+            # bottom-k shadow sample rides ingest; harvests then carry
+            # OBSERVED error next to the analytic bound (which is free
+            # and always present, plane on or off)
+            ParamDesc(key="audit-sample", default="0",
+                      type_hint=TypeHint.INT,
+                      validator=validate_int_range(lo=0),
+                      description="shadow-sample capacity for the "
+                                  "accuracy audit plane (keys held as "
+                                  "ground truth; 0 = plane off — "
+                                  "summaries then carry analytic bounds "
+                                  "only)"),
             # multi-chip sharded ingest (ISSUE 14): one fused bundle
             # replica per chip, batches round-robined onto per-device
             # lanes, psum/pmax collective merge at harvest only
@@ -557,6 +575,21 @@ class TpuSketchInstance(OperatorInstance):
             raise ParamError(
                 f"param 'quantile-field': {self._qt_field!r} is not a "
                 f"wire column (one of {', '.join(BATCH_COLUMNS)})")
+        # -- accuracy audit plane (ISSUE 19) ------------------------------
+        # Host-side deterministic bottom-k shadow sample: run-scoped for
+        # harvest audits, window-scoped for sealed-window rs lanes. Off
+        # (capacity 0) costs nothing — no sample, no gauges registered,
+        # byte-identical summaries/digests.
+        self._audit_k = (p.get("audit-sample").as_int()
+                         if "audit-sample" in p else 0)
+        self._shadow = None
+        self._win_shadow = None
+        self._astats = None
+        if self._audit_k > 0:
+            from ..ops.accuracy import AccuracyStats, ShadowSample
+            self._shadow = ShadowSample(self._audit_k)
+            self._win_shadow = ShadowSample(self._audit_k)
+            self._astats = AccuracyStats(ctx.run_id, ctx.desc.full_name)
         self.bundle = bundle_init(
             depth=p.get("depth").as_int(),
             log2_width=p.get("log2-width").as_int(),
@@ -691,6 +724,10 @@ class TpuSketchInstance(OperatorInstance):
         from ..telemetry.pipeline import PipelineStats
         self._pstats = PipelineStats(ctx.run_id, ctx.desc.full_name)
         self._pstats.register()
+        if self._astats is not None:
+            # registered only when the audit plane is on: a plane-off
+            # run must leave no accuracy gauges or live rows behind
+            self._astats.register()
         # -- sketch-history plane (sealed windows, history/) --------------
         self._hist_on = p.get("history").as_bool() if "history" in p else False
         if self._hist_on:
@@ -897,6 +934,20 @@ class TpuSketchInstance(OperatorInstance):
              else int(n - np.count_nonzero(vals_np[:n])))
         if z > 0:
             _tm_qt_zero.inc(z)
+
+    # -- accuracy audit plane helpers (ISSUE 19) ----------------------------
+
+    def _shadow_feed(self, keys: np.ndarray,
+                     weights: np.ndarray | None = None) -> None:
+        """Feed the real rows of one host batch into the run-scoped and
+        window-scoped shadow samples. Host numpy only, off the device
+        path; ShadowSample.update copies what it keeps, so passing a
+        view of a pinned staging block is safe. Plane-off is one branch."""
+        if self._shadow is None:
+            return
+        self._shadow.update(keys, weights)
+        self._win_shadow.update(keys, weights)
+        self._astats.note_fed(int(np.asarray(keys).size))
 
     @staticmethod
     def _padded_mntns(batch: EventBatch, n: int, pad: int) -> np.ndarray:
@@ -1256,6 +1307,10 @@ class TpuSketchInstance(OperatorInstance):
             if tmin > 0.0:
                 oldest = tmin / 1e9
         self._note_watermarks(batch.pop_ts, oldest, lane)
+        # accuracy audit plane: the heavy-hitter key lane's real rows
+        # feed the shadow sample host-side (weight 1 per event, matching
+        # the staged weight lane)
+        self._shadow_feed(hh[:n])
         # late enrichment (display-only work off the ingest path): two
         # vectorized slice writes park a small (k64, k32, comm) sample in
         # the rolling ring; name resolution happens at harvest/seal time
@@ -1376,6 +1431,9 @@ class TpuSketchInstance(OperatorInstance):
         self._stats.events += n
         self._stats.drops = fb.drops
         self._note_watermarks(fb.pop_ts, fb.oldest_ts, lane)
+        # accuracy audit plane: folded batches carry real integer
+        # weights — the shadow's ground-truth totals honor them
+        self._shadow_feed(fb.keys[:n], fb.weights[:n])
         if self._hist_on and self._hist_interval > 0 and \
                 self._hist_clock() - self._win_start >= self._hist_interval:
             self.seal_window()
@@ -1551,6 +1609,10 @@ class TpuSketchInstance(OperatorInstance):
             drops = float(b.drops)
             ent_now = np.asarray(b.entropy.counts).copy()
             cand = np.asarray(b.topk.keys).copy()
+            # the satellite bugfix: the candidate-overflow latch crosses
+            # the seal boundary — an overflowed run's windows carry
+            # approx=True so merged/historical answers stay tainted
+            overflow = bool(int(np.asarray(b.topk.overflow)))
             inv_now = self._inv_host(b)
             qt_now = self._qt_host(b)
         win_events = int(events - self._win_events0)
@@ -1590,6 +1652,15 @@ class TpuSketchInstance(OperatorInstance):
                 qt_alpha=float(self._qt_alpha),
                 qt_min_value=float(self._qt_minv),
             )
+        # accuracy audit plane: the WINDOW-scoped shadow sample rides the
+        # sealed window (copies — the live sample resets below); plane-off
+        # runs add no keys to the frame or the digest
+        if self._win_shadow is not None:
+            inv_kw.update(
+                rs_keys=self._win_shadow.keys.copy(),
+                rs_weights=self._win_shadow.weights.copy(),
+                rs_capacity=int(self._win_shadow.capacity),
+            )
         win = SealedWindow(
             gadget=self._hist_gadget,
             node=self.ctx.extra.get("node", "") or "",
@@ -1609,6 +1680,7 @@ class TpuSketchInstance(OperatorInstance):
                     for key, s in self._win_slices.items()},
             names={k: self._names[k] for k, _ in keep if k in self._names},
             slices_dropped=len(self._win_slices_dropped_keys),
+            approx=overflow,
             **inv_kw,
         )
         win.digest = window_digest(win)
@@ -1675,6 +1747,8 @@ class TpuSketchInstance(OperatorInstance):
         self._win_ent0 = ent_now
         self._win_inv0 = inv_now
         self._win_qt0 = qt_now
+        if self._win_shadow is not None:
+            self._win_shadow.reset()
         self._win_slices = {}
         self._win_slices_dropped_keys = set()
 
@@ -1802,6 +1876,29 @@ class TpuSketchInstance(OperatorInstance):
                             starved_ratio=pipe_out["starved_ratio"],
                             stall_s=pipe_out["stall_s"]):
                 pass
+        # accuracy audit plane (ISSUE 19): per-stat analytic envelopes
+        # from the live geometry + observed mass, with OBSERVED error vs
+        # the run-scoped shadow sample. Plane-off harvests carry
+        # accuracy=None — wire headers and digests stay byte-identical
+        acc_out = None
+        if self._shadow is not None:
+            from ..ops.accuracy import accuracy_block
+            depth, width = self.bundle.cms.table.shape
+            acc_out = accuracy_block(
+                events=float(events_f),
+                depth=int(depth), width=int(width),
+                hll_p=int(np.log2(max(
+                    self.bundle.hll.registers.shape[0], 2))),
+                ent_log2_width=int(np.log2(max(
+                    self.bundle.entropy.counts.shape[0], 2))),
+                distinct=float(distinct),
+                entropy_bits=float(entropy_bits),
+                hh_keys=np.array([k for k, _ in hh], dtype=np.uint32),
+                hh_counts=np.array([c for _, c in hh], dtype=np.int64),
+                qt_alpha=(float(self._qt_alpha) if self._qt_on else None),
+                shadow=self._shadow,
+            )
+            self._astats.observe_block(acc_out)
         # late enrichment: names resolve HERE (once per tick, from the
         # sample ring), not in the per-batch ingest path
         self._resolve_late([k for k, _ in hh[:32]])
@@ -1837,6 +1934,7 @@ class TpuSketchInstance(OperatorInstance):
             classes=classes_out,
             quantiles=qt_out,
             pipeline=pipe_out,
+            accuracy=acc_out,
         )
         # read the consumer LIVE from ctx.extra (falling back to the one
         # captured at init): the alerts operator chains its engine into
@@ -1897,6 +1995,8 @@ class TpuSketchInstance(OperatorInstance):
                 _queries_engine.unregister(self.ctx.run_id)
             self._stats.unregister()
             self._pstats.unregister()
+            if self._astats is not None:
+                self._astats.unregister()
             if _ckpt_dir is not None:
                 # shutdown save stays best-effort, but failures are now
                 # logged, counted, and retried — never silently swallowed

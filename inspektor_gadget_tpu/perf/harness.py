@@ -487,6 +487,26 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
                     qt_s = qt_step(qt_s, qv)
                     jax.block_until_ready(qt_s.counts)
 
+        # accuracy audit plane cost at this batch shape (ISSUE 19): a
+        # post-loop micro-measurement of the bottom-k shadow-sample fold
+        # (the merge-stage pattern), projected onto this run's measured
+        # wall clock — extra.audit_overhead is the fraction of ingest
+        # time `audit-sample > 0` would have added at this config, the
+        # same quantity perf/accuracy_bench.py's dedicated series gates.
+        from ..ops.accuracy import ShadowSample
+        audit_keys64 = np.arange(1, batch_n + 1, dtype=np.uint64) * np.uint64(
+            0x9E3779B97F4A7C15)
+        audit_sh = ShadowSample(1024)
+        audit_sh.update(_fold32(audit_keys64))  # warm: fill the reservoir
+        audit_reps = max(int(cfg["merges"]), 8)
+        t_a = time.perf_counter()
+        for _ in range(audit_reps):
+            with clock.stage("audit_feed", True):
+                audit_sh.update(_fold32(audit_keys64))
+        audit_s = max(time.perf_counter() - t_a, 1e-9)
+        audit_proj = (audit_s / audit_reps) * max(steps, 1)
+        audit_overhead = audit_proj / max(elapsed + audit_proj, 1e-9)
+
         run_span.set_attr("events", events)
         run_span.set_attr("ev_per_s", round(events / max(elapsed, 1e-9), 1))
         trace_id = run_span.context.trace_id
@@ -548,6 +568,8 @@ def run_harness(config: str = "e2e", *, platform: str = "auto",
     if quantiles:
         extra_fields["quantiles"] = True
         extra_fields["qt_geometry"] = "2048@alpha0.01"
+    # the audit plane's relative feed cost vs the staging copy it rides
+    extra_fields["audit_overhead"] = round(audit_overhead, 4)
     if pstats is not None:
         psnap = pstats.snapshot()
         pstats.unregister()  # return the shared gauges to baseline

@@ -45,11 +45,15 @@ SCHEMA_ID = "ig-tpu/perf-record/v1"
 #            fold (on the hot path the fused kernel carries the plane —
 #            extra.quantiles marks the record) and qt_merge the
 #            bucket-wise sketch merge at cluster-fold shape
+#   accuracy (ISSUE 19): audit_feed is the host-side bottom-k shadow-
+#            sample fold the accuracy audit plane adds per batch (rides
+#            an existing host lane; harness records its relative cost
+#            as extra.audit_overhead)
 STAGES = ("pop", "decode", "enrich", "fold32", "pop_folded", "h2d",
           "h2d_overlap", "h2d_lanes", "bundle_update", "fused_update",
           "sharded_update", "inv_update", "inv_decode", "qt_update",
-          "qt_merge", "harvest", "merge", "sq_refresh", "sq_recompute",
-          "sq_cache_hit")
+          "qt_merge", "audit_feed", "harvest", "merge", "sq_refresh",
+          "sq_recompute", "sq_cache_hit")
 
 # stages whose seconds count as HOST-plane ingest cost (the acceptance
 # comparison pop_folded→h2d vs pop→decode→enrich→fold32 sums these)

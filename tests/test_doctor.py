@@ -33,7 +33,8 @@ def test_probe_windows_names_and_shape():
                 "captrace", "fstrace", "sockstate", "sigtrace",
                 "container_runtime", "capture_dir", "history_dir",
                 "history_tiers", "standing_queries", "fleet_health",
-                "shared_runs", "device_topology", "pipeline_health"}
+                "shared_runs", "device_topology", "pipeline_health",
+                "accuracy"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail

@@ -858,3 +858,51 @@ def test_quantile_plane_counters_follow_value_lane():
                 inst._stager.drain()
             inst._stats.unregister()
             inst._pstats.unregister()
+
+
+def test_accuracy_gauges_return_to_baseline_across_churn():
+    """Accuracy audit plane (ISSUE 19) gauge discipline: a run that set
+    observed-error gauges and the drift ratio must return every
+    `ig_sketch_accuracy_*` gauge exactly to baseline on unregister
+    (the counter stays monotonic — counters never rewind)."""
+    import numpy as np
+
+    from inspektor_gadget_tpu.ops.accuracy import (
+        AccuracyStats, ShadowSample, accuracy_block, live_stats)
+
+    obs0 = _default_metric("ig_sketch_accuracy_observed_err")
+    ratio0 = _default_metric("ig_sketch_accuracy_ratio")
+    fed0 = _default_metric("ig_sketch_audit_samples_total")
+    keys = (np.arange(1, 401, dtype=np.uint32) % 40) + 1
+    sh = ShadowSample(64)
+    sh.update(keys)
+    uk, uc = np.unique(keys, return_counts=True)
+    a = AccuracyStats("run-acc-tm-1", "trace/exec")
+    a.register()
+    try:
+        a.note_fed(keys.size)
+        a.observe_block(accuracy_block(
+            events=float(keys.size), depth=3, width=1024, hll_p=8,
+            ent_log2_width=6, distinct=float(uk.size) + 1.0,
+            entropy_bits=4.0, hh_keys=uk[:8],
+            hh_counts=uc[:8].astype(np.int64) + 2, shadow=sh))
+        assert _default_metric("ig_sketch_audit_samples_total") == fed0 + 400
+        # audited stats set their observed-error gauges + the ratio
+        assert _default_metric("ig_sketch_accuracy_observed_err",
+                               stat="heavy_hitters") > 0.0
+        assert _default_metric("ig_sketch_accuracy_observed_err",
+                               stat="distinct") > 0.0
+        assert _default_metric("ig_sketch_accuracy_ratio") > 0.0
+        assert any(s.run_id == "run-acc-tm-1" for s in live_stats())
+        text = telemetry.render_prometheus()
+        assert "ig_sketch_accuracy_observed_err" in text
+        assert "ig_sketch_accuracy_ratio" in text
+        assert "ig_sketch_audit_samples_total" in text
+    finally:
+        a.unregister()
+    # every gauge the run touched is exactly back at baseline
+    assert _default_metric("ig_sketch_accuracy_observed_err") == obs0
+    assert _default_metric("ig_sketch_accuracy_ratio") == ratio0
+    assert not any(s.run_id == "run-acc-tm-1" for s in live_stats())
+    # the feed counter is monotonic: unregister must not rewind it
+    assert _default_metric("ig_sketch_audit_samples_total") == fed0 + 400

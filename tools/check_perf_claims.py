@@ -21,6 +21,14 @@ README.md for "N ev/s"-shaped claims and, for each one:
      claim's line must say "cpu" or "degraded" — a number measured on a
      CPU fallback may not read as a TPU result.
 
+Accuracy claims (ISSUE 19) get the same treatment: "error … under N%"
+prose (docs AND the sketch-op docstrings in CODE_FILES — e.g.
+ops/countmin.py's "well under the 1%") must be backed by a ledger
+record whose `extra.observed_err_pct` sits at or inside the claimed
+ceiling. These are bound-style claims (artifact ≤ ceiling, not a ±tol
+band) and are exempt from the cpu/degraded rule — the sketch's error is
+arithmetic, not machine speed.
+
 Run standalone (``python tools/check_perf_claims.py [repo_root]``, exit
 1 on violations) or through tier-1 (tests/test_perf_claims.py).
 """
@@ -34,6 +42,9 @@ import re
 import sys
 
 DOC_FILES = ("docs/performance.md", "BASELINE.md", "README.md")
+# code files whose docstrings make accuracy promises — the "well under
+# the 1%" prose is a claim like any other and gets the same no-drift rule
+CODE_FILES = ("inspektor_gadget_tpu/ops/countmin.py",)
 BENCH_GLOB = "BENCH_r*.json"
 LEDGER = "benchmarks/ledger/PERF.jsonl"
 
@@ -67,6 +78,14 @@ STARVED_RE = re.compile(
     r"\s*%\s*starved"
     r"|starved\s*(?P<prefix_b>[~≥≤<>=]\s*)?"
     r"(?P<num_b>\d+(?:\.\d+)?)\s*%",
+    re.IGNORECASE | re.UNICODE)
+
+# accuracy-bound claims (ISSUE 19): "relative error well under the 1%",
+# "error stays below 0.5%" — the number is a CEILING the shadow-audited
+# observed error (ledger extra.observed_err_pct) must sit inside
+ERR_RE = re.compile(
+    r"error\s+(?:stays\s+)?(?:well\s+)?(?:under|below|within)\s+"
+    r"(?:the\s+)?(?P<num>\d+(?:\.\d+)?)\s*%",
     re.IGNORECASE | re.UNICODE)
 
 
@@ -132,6 +151,13 @@ def extract_claims(text: str, path: str) -> list[Claim]:
                       line=line, lo=lo, hi=hi, approx=prefix == "~",
                       kind="starved_pct"),
                 prefix, lower))
+        for m in ERR_RE.finditer(line):
+            ceiling = float(m.group("num"))
+            out.append(_classify(
+                Claim(path=path, lineno=lineno, text=m.group(0),
+                      line=line, lo=0.0, hi=ceiling, approx=False,
+                      kind="err_pct"),
+                "", lower))
     return out
 
 
@@ -184,6 +210,11 @@ def _ledger_backings(path: pathlib.Path) -> list[Backing]:
             out.append(Backing(float(sf) * 100.0, platform, degraded,
                                f"{src}#starved_fraction",
                                kind="starved_pct"))
+        oe = (rec.get("extra") or {}).get("observed_err_pct")
+        if isinstance(oe, (int, float)):
+            out.append(Backing(float(oe), platform, degraded,
+                               f"{src}#observed_err_pct",
+                               kind="err_pct"))
     return out
 
 
@@ -202,6 +233,10 @@ def collect_backings(root: pathlib.Path) -> list[Backing]:
 def _matches(claim: Claim, b: Backing) -> bool:
     if b.kind != claim.kind:
         return False
+    if claim.kind == "err_pct":
+        # bound-style: the artifact must sit at or inside the claimed
+        # ceiling — an observed error above it falsifies the prose
+        return 0.0 <= b.value <= claim.hi
     tol = TOL_APPROX if claim.approx else TOL
     return claim.lo * (1 - tol) <= b.value <= claim.hi * (1 + tol)
 
@@ -220,7 +255,9 @@ def check_claim(claim: Claim, backings: list[Backing]) -> str:
         return (f"{claim.path}:{claim.lineno}: claim '{claim.text.strip()}' "
                 f"is backed by NO ledger/BENCH artifact{hint} — record it, "
                 f"fix it, or label it 'unrecorded'")
-    if all(b.second_class for b in hits):
+    if all(b.second_class for b in hits) and claim.kind != "err_pct":
+        # err_pct is exempt: sketch error is arithmetic, the same on any
+        # platform — a CPU-audited bound is as real as a TPU one
         lower = claim.line.lower()
         if "cpu" not in lower and "degraded" not in lower:
             srcs = ", ".join(sorted({b.source for b in hits})[:3])
@@ -238,7 +275,7 @@ def check_repo(root: str | pathlib.Path) -> tuple[list[str], int, int]:
     backings = collect_backings(root)
     violations: list[str] = []
     checked = waived = 0
-    for rel in DOC_FILES:
+    for rel in DOC_FILES + CODE_FILES:
         p = root / rel
         if not p.exists():
             continue
