@@ -65,6 +65,16 @@ WIRE_EVENT_IDS: dict[str, int] = {
 }
 
 
+# Fleet aggregation tier (fleet/): the accounting header an aggregator
+# republishes alongside its ONE merged summary window. The proto mirror
+# is the FleetAggregate message in ig.proto — tests/test_proto.py pins
+# field-name drift between these constants and the proto text.
+FLEET_AGGREGATE_SCHEMA = "ig-tpu/fleet-aggregate/v1"
+FLEET_AGGREGATE_FIELDS = ("schema", "aggregator", "gadget", "children",
+                          "folded", "missing", "skipped", "approx",
+                          "digest")
+
+
 # Shared-run subscriber vocabulary — ONE home for the values the client
 # validates before the wire, the agent re-validates server-side, and the
 # runtime params layer offers as one_of choices (three call sites, one

@@ -95,6 +95,21 @@ def add_fleet_parser(sub) -> None:
     ap.add_argument("-o", "--output", default="table",
                     choices=["table", "json"])
     ap.set_defaults(func=cmd_fleet_accuracy)
+    tp = fsub.add_parser(
+        "topology", help="render the fleet merge tree: zones, "
+        "aggregators, depth/fan-in, and the wire cost of one merged "
+        "query through the tree vs the flat fold")
+    tp.add_argument("--remote", default="",
+                    help="name=target[,...]; defaults to the local fleet")
+    tp.add_argument("--topology", default="auto",
+                    help="'auto', 'auto:<fan_in>', or the declared zone "
+                         "grammar 'zone-a=n0,n1;zone-b=n2' (default "
+                         "auto)")
+    tp.add_argument("--fan-in", type=int, default=0,
+                    help="shorthand for --topology auto:<N>")
+    tp.add_argument("-o", "--output", default="table",
+                    choices=["table", "json"])
+    tp.set_defaults(func=cmd_fleet_topology)
 
 
 def _probe_agent(node: str, target: str, deadline: float) -> dict:
@@ -167,6 +182,45 @@ def _resolve_targets(args) -> dict | None:
         return None
 
 
+def _sweep_agents(targets: dict, deadline: float, extract,
+                  **defaults) -> list[dict]:
+    """The ONE per-agent sweep every fleet verb uses: dial each agent
+    under the bounded deadline, merge `extract(client)`'s dict into the
+    node row, and capture failures as the row's `error` (per-node
+    isolation — an unreachable agent is a row, never an exception).
+    Each verb used to hand-roll this loop with its own error shape; one
+    helper means one rc contract and one unreachable row everywhere."""
+    from ..agent.client import AgentClient
+    rows: list[dict] = []
+    for node, target in targets.items():
+        row: dict = {"node": node, "target": target, "error": "",
+                     **{k: (v.copy() if isinstance(v, (list, dict))
+                            else v) for k, v in defaults.items()}}
+        client = None
+        try:
+            client = AgentClient(target, node, rpc_deadline=deadline)
+            row.update(extract(client))
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            row["error"] = str(e)
+        finally:
+            if client is not None:
+                client.close()
+        rows.append(row)
+    return rows
+
+
+def _unreachable_line(row: dict, width: int = 12) -> str:
+    """The uniform unreachable row every fleet table prints (the runs
+    verb used to render a dashed variant — one shape, one test)."""
+    return f"{row['node']:<{width}s} unreachable: {row['error']}"
+
+
+def _fleet_rc(rows: list[dict]) -> int:
+    """The uniform fleet-verb exit code: 0 when every agent answered,
+    1 when any row is an error."""
+    return 0 if not any(r.get("error") for r in rows) else 1
+
+
 def _sub_summary(run: dict) -> tuple[str, str, int, int]:
     """(classes, queue, drops, evictions) strings/counts for one run's
     subscriber rows."""
@@ -197,38 +251,25 @@ def cmd_fleet_runs(args) -> int:
         print("no agents (use deploy --local N or --remote)",
               file=sys.stderr)
         return 2
-    from ..agent.client import AgentClient
-    per_node: list[dict] = []
-    for node, target in targets.items():
-        row: dict = {"node": node, "target": target, "runs": [],
-                     "error": ""}
-        client = None
-        try:
-            client = AgentClient(target, node, rpc_deadline=args.deadline)
-            runs = client.dump_state().get("runs") or []
-            if not args.all:
-                runs = [r for r in runs
-                        if r.get("shared") and not r.get("done")]
-            if args.gadget:
-                runs = [r for r in runs if r.get("gadget") == args.gadget]
-            row["runs"] = runs
-        except Exception as e:  # noqa: BLE001 — per-node isolation
-            row["error"] = str(e)
-        finally:
-            if client is not None:
-                client.close()
-        per_node.append(row)
+    def extract(client) -> dict:
+        runs = client.dump_state().get("runs") or []
+        if not args.all:
+            runs = [r for r in runs
+                    if r.get("shared") and not r.get("done")]
+        if args.gadget:
+            runs = [r for r in runs if r.get("gadget") == args.gadget]
+        return {"runs": runs}
+
+    per_node = _sweep_agents(targets, args.deadline, extract, runs=[])
     if args.output == "json":
         print(json.dumps({"agents": per_node}, indent=2, default=str))
-        return 0 if not any(r["error"] for r in per_node) else 1
+        return _fleet_rc(per_node)
     print(f"{'NODE':<12s} {'RUN':<22s} {'GADGET':<16s} {'SUBS':>4s} "
           f"{'CLASSES':<14s} {'QUEUE':>9s} {'DROPS':>6s} {'EVICT':>5s}  "
           f"STATE")
     for r in per_node:
         if r["error"]:
-            print(f"{r['node']:<12s} {'-':<22s} {'-':<16s} {'-':>4s} "
-                  f"{'-':<14s} {'-':>9s} {'-':>6s} {'-':>5s}  "
-                  f"unreachable: {r['error']}")
+            print(_unreachable_line(r))
             continue
         if not r["runs"]:
             print(f"{r['node']:<12s} {'-':<22s} {'-':<16s} {0:>4d} "
@@ -250,7 +291,7 @@ def cmd_fleet_runs(args) -> int:
                   f"{run.get('gadget', ''):<16s} "
                   f"{run.get('live_subscribers', 0):>4d} {cls:<14s} "
                   f"{q:>9s} {drops:>6d} {evictions:>5d}  {state}")
-    return 0 if not any(r["error"] for r in per_node) else 1
+    return _fleet_rc(per_node)
 
 
 def cmd_fleet_queries(args) -> int:
@@ -265,34 +306,22 @@ def cmd_fleet_queries(args) -> int:
         print("no agents (use deploy --local N or --remote)",
               file=sys.stderr)
         return 2
-    from ..agent.client import AgentClient
-    per_node: list[dict] = []
-    for node, target in targets.items():
-        row: dict = {"node": node, "target": target, "queries": [],
-                     "error": ""}
-        client = None
-        try:
-            client = AgentClient(target, node, rpc_deadline=args.deadline)
-            qrows = (client.dump_state().get("standing_queries") or [])
-            if args.gadget:
-                qrows = [q for q in qrows
-                         if q.get("gadget") == args.gadget]
-            row["queries"] = qrows
-        except Exception as e:  # noqa: BLE001 — per-node isolation
-            row["error"] = str(e)
-        finally:
-            if client is not None:
-                client.close()
-        per_node.append(row)
+    def extract(client) -> dict:
+        qrows = (client.dump_state().get("standing_queries") or [])
+        if args.gadget:
+            qrows = [q for q in qrows if q.get("gadget") == args.gadget]
+        return {"queries": qrows}
+
+    per_node = _sweep_agents(targets, args.deadline, extract, queries=[])
     if args.output == "json":
         print(json.dumps({"agents": per_node}, indent=2, default=str))
-        return 0 if not any(r["error"] for r in per_node) else 1
+        return _fleet_rc(per_node)
     print(f"{'NODE':<12s} {'QUERY':<18s} {'GADGET':<16s} {'RANGE':>8s} "
           f"{'WIN':>4s} {'EVENTS':>12s} {'TICKS':>6s} {'PUB':>5s} "
           f"{'FOLDS':>6s} {'CACHE h/m/i':>12s}")
     for r in per_node:
         if r["error"]:
-            print(f"{r['node']:<12s} unreachable: {r['error']}")
+            print(_unreachable_line(r))
             continue
         if not r["queries"]:
             print(f"{r['node']:<12s} no standing queries")
@@ -307,32 +336,20 @@ def cmd_fleet_queries(args) -> int:
                   f"{q.get('events', 0):>12,d} {q.get('ticks', 0):>6d} "
                   f"{q.get('published', 0):>5d} {q.get('folds', 0):>6d} "
                   f"{cache_s:>12s}")
-    return 0 if not any(r["error"] for r in per_node) else 1
+    return _fleet_rc(per_node)
 
 
 def _poll_pipeline(targets: dict, deadline: float,
                    gadget: str) -> list[dict]:
     """One DumpState sweep → [{node, error, runs: [pipeline rows]}]."""
-    from ..agent.client import AgentClient
-    per_node: list[dict] = []
-    for node, target in targets.items():
-        row: dict = {"node": node, "target": target, "runs": [],
-                     "error": ""}
-        client = None
-        try:
-            client = AgentClient(target, node, rpc_deadline=deadline)
-            runs = client.dump_state().get("pipeline") or []
-            runs = [r for r in runs if "error" not in r]
-            if gadget:
-                runs = [r for r in runs if r.get("gadget") == gadget]
-            row["runs"] = runs
-        except Exception as e:  # noqa: BLE001 — per-node isolation
-            row["error"] = str(e)
-        finally:
-            if client is not None:
-                client.close()
-        per_node.append(row)
-    return per_node
+    def extract(client) -> dict:
+        runs = client.dump_state().get("pipeline") or []
+        runs = [r for r in runs if "error" not in r]
+        if gadget:
+            runs = [r for r in runs if r.get("gadget") == gadget]
+        return {"runs": runs}
+
+    return _sweep_agents(targets, deadline, extract, runs=[])
 
 
 def _fmt_lag(v: float) -> str:
@@ -352,7 +369,7 @@ def _print_lag_table(per_node: list[dict], prev: dict, dt: float) -> dict:
     counts: dict = {}
     for r in per_node:
         if r["error"]:
-            print(f"{r['node']:<12s} unreachable: {r['error']}")
+            print(_unreachable_line(r))
             continue
         if not r["runs"]:
             print(f"{r['node']:<12s} no instrumented runs")
@@ -382,7 +399,6 @@ def cmd_fleet_accuracy(args) -> int:
     (node, run, stat) with the analytic error bound, the observed error
     vs the shadow-sample ground truth, and whether the stat was audited
     at all — the fleet-wide answer to "can I trust these numbers"."""
-    from ..agent.client import AgentClient
     targets = _resolve_targets(args)
     if targets is None:
         return 2
@@ -390,33 +406,24 @@ def cmd_fleet_accuracy(args) -> int:
         print("no agents (use deploy --local N or --remote)",
               file=sys.stderr)
         return 2
-    per_node: list[dict] = []
-    for node, target in targets.items():
-        row: dict = {"node": node, "target": target, "runs": [],
-                     "error": ""}
-        client = None
-        try:
-            client = AgentClient(target, node, rpc_deadline=args.deadline)
-            runs = client.dump_state().get("accuracy") or []
-            runs = [r for r in runs if "error" not in r]
-            if args.gadget:
-                runs = [r for r in runs if r.get("gadget") == args.gadget]
-            row["runs"] = runs
-        except Exception as e:  # noqa: BLE001 — per-node isolation
-            row["error"] = str(e)
-        finally:
-            if client is not None:
-                client.close()
-        per_node.append(row)
+
+    def extract(client) -> dict:
+        runs = client.dump_state().get("accuracy") or []
+        runs = [r for r in runs if "error" not in r]
+        if args.gadget:
+            runs = [r for r in runs if r.get("gadget") == args.gadget]
+        return {"runs": runs}
+
+    per_node = _sweep_agents(targets, args.deadline, extract, runs=[])
     if args.output == "json":
         print(json.dumps({"agents": per_node}, indent=2, default=str))
-        return 0 if not any(r["error"] for r in per_node) else 1
+        return _fleet_rc(per_node)
     print(f"{'NODE':<12s} {'RUN':<14s} {'STAT':<14s} {'BOUND':>10s} "
           f"{'OBSERVED':>10s} {'AUDITED':>7s} {'SAMPLE':>7s} "
           f"{'RATIO':>6s}")
     for r in per_node:
         if r["error"]:
-            print(f"{r['node']:<12s} unreachable: {r['error']}")
+            print(_unreachable_line(r))
             continue
         if not r["runs"]:
             print(f"{r['node']:<12s} no audited runs (audit-sample 0?)")
@@ -432,7 +439,7 @@ def cmd_fleet_accuracy(args) -> int:
                       f"{(f'{obs:.5f}' if obs is not None else '-'):>10s} "
                       f"{('yes' if srow.get('audited') else 'no'):>7s} "
                       f"{sample:>7d} {ratio:>6s}")
-    return 0 if not any(r["error"] for r in per_node) else 1
+    return _fleet_rc(per_node)
 
 
 def cmd_fleet_lag(args) -> int:
@@ -468,4 +475,52 @@ def cmd_fleet_lag(args) -> int:
             _time.sleep(args.watch)
         except KeyboardInterrupt:
             break
-    return 0 if not any(r["error"] for r in per_node) else 1
+    return _fleet_rc(per_node)
+
+
+def cmd_fleet_topology(args) -> int:
+    """Render the merge tree the aggregation tier would fold this fleet
+    through: zone membership, depth/fan-in, and the wire cost of one
+    merged query — tree edges + 1 root frame vs one frame per node flat,
+    with the client's own link load dropping from N to fan-in."""
+    from ..fleet import TopologyError, parse_topology
+    targets = _resolve_targets(args)
+    if targets is None:
+        return 2
+    if not targets:
+        print("no agents (use deploy --local N or --remote)",
+              file=sys.stderr)
+        return 2
+    spec = f"auto:{args.fan_in}" if args.fan_in else args.topology
+    try:
+        topo = parse_topology(spec, list(targets))
+    except TopologyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    n = len(topo.leaves())
+    if args.output == "json":
+        print(json.dumps({"spec": spec, "topology": topo.to_dict(),
+                          "wire_windows_tree": topo.edges() + 1,
+                          "wire_windows_flat": n}, indent=2))
+        return 0
+
+    def render(node, indent: int = 0) -> None:
+        pad = "  " * indent
+        if node.is_leaf:
+            print(f"{pad}{node.id}")
+            return
+        kinds = sum(1 for c in node.children if not c.is_leaf)
+        what = (f"{len(node.children)} zone(s)" if kinds
+                else f"{len(node.children)} agent(s)")
+        print(f"{pad}{node.id}/  [{what}]")
+        for c in node.children:
+            render(c, indent + 1)
+
+    print(f"merge tree over {n} agent(s): depth {topo.depth()}, "
+          f"fan-in {topo.fan_in()}, {len(topo.aggregators())} "
+          f"aggregator(s)")
+    print(f"wire cost per merged query: {topo.edges() + 1} window "
+          f"frame(s) through the tree vs {n} flat; client link folds "
+          f"{len(topo.root.children)} instead of {n}")
+    render(topo.root)
+    return 0

@@ -55,6 +55,12 @@ def add_query_parser(sub) -> None:
                     help="print the merged latency quantiles (p50/p90/"
                          "p99/p99.9) and a log2 ASCII histogram; needs "
                          "windows sealed with 'quantiles true'")
+    qp.add_argument("--topology", default="",
+                    help="route the fold through the fleet aggregation "
+                         "tier: 'auto', 'auto:<fan_in>', or a declared "
+                         "zone grammar like 'zone-a=n0,n1;zone-b=n2' "
+                         "(byte-identical answer, O(log N) fan-in; "
+                         "remote mode only)")
     qp.add_argument("-o", "--output", default="table",
                     choices=["table", "json"])
     qp.set_defaults(func=cmd_query)
@@ -72,6 +78,9 @@ def cmd_query(args) -> int:
     ranges = dict(gadget=args.gadget, start_ts=start_ts, end_ts=end_ts,
                   start_seq=args.start_seq, end_seq=args.end_seq)
     key = args.key or None
+    # getattr: programmatic callers hand in plain namespaces that
+    # predate the fleet tier; only the parser guarantees the attribute
+    topology = getattr(args, "topology", "")
 
     if args.remote:
         from .main import parse_targets
@@ -83,10 +92,25 @@ def cmd_query(args) -> int:
             return 2
         runtime = GrpcRuntime(targets)
         try:
-            answer = runtime.query_history(key=key, top=args.top, **ranges)
+            if topology:
+                from ..fleet import TopologyError
+                try:
+                    answer = runtime.query_history(
+                        key=key, top=args.top, topology=topology,
+                        **ranges)
+                except TopologyError as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 2
+            else:
+                answer = runtime.query_history(key=key, top=args.top,
+                                               **ranges)
         finally:
             runtime.close()
     else:
+        if topology:
+            print("error: --topology needs --remote (the aggregation "
+                  "tier folds across agents)", file=sys.stderr)
+            return 2
         from ..history import HISTORY, answer_query, decode_frames
         losses: list = []
         frames = list(HISTORY.fetch_windows(
@@ -185,6 +209,21 @@ def _print_answer(answer, *, key: str | None, show_slices: bool,
     if fallback:
         print(f"note: node(s) {', '.join(fallback)} answered via "
               "list+fetch fallback (pre-pushdown agent)")
+    if answer.fleet:
+        fl = answer.fleet
+        print(f"merge tree: depth {fl['depth']}, fan-in {fl['fan_in']}, "
+              f"{fl['aggregators']} aggregator(s), "
+              f"{fl['subtree_folds']} subtree fold(s)")
+        if fl.get("fallback"):
+            print(f"note: aggregator(s) {', '.join(fl['fallback'])} "
+                  "unreachable or crashed mid-fold — their subtrees "
+                  "were re-folded flat from the leaves (exactly-once; "
+                  "answer unchanged)")
+        flat = sorted(n for n, p in answer.paths.items()
+                      if p == "flat-fallback")
+        if flat:
+            print(f"note: leaf/leaves {', '.join(flat)} answered via "
+                  "the flat fallback path")
     # error envelopes (accuracy audit plane): analytic bounds ride every
     # answer; ± annotations draw from them inline
     acc = answer.accuracy or {}

@@ -592,6 +592,33 @@ def _probe_accuracy() -> Window:
         return Window("accuracy", False, repr(e))
 
 
+def _probe_fleet_topology() -> Window:
+    """Fleet-aggregation-tier row (ISSUE 20): can this process build a
+    merge tree over the deployed fleet, and what shape would it fold
+    through — leaves, depth, fan-in, and the wire frames one merged
+    query costs vs the flat fold. No deployed fleet is fine (the tier
+    is a query-time choice); the row fails only when the deploy state
+    names agents the topology builder refuses (the loud TopologyError
+    an `ig-tpu query --topology` would hit)."""
+    try:
+        from .cli.deploy import local_targets
+        from .fleet import auto_topology
+        targets = local_targets()
+        if not targets:
+            return Window("fleet_topology", True,
+                          "no deployed fleet (topology is a query-time "
+                          "choice: ig-tpu query --topology auto)")
+        topo = auto_topology(list(targets))
+        return Window(
+            "fleet_topology", True,
+            f"{len(topo.leaves())} agent(s) → depth {topo.depth()}, "
+            f"fan-in {topo.fan_in()}, {len(topo.aggregators())} "
+            f"aggregator(s); {topo.edges() + 1} window frame(s)/query "
+            f"vs {len(topo.leaves())} flat")
+    except Exception as e:  # noqa: BLE001
+        return Window("fleet_topology", False, repr(e))
+
+
 def _probe_mountinfo() -> Window:
     try:
         with open("/proc/self/mountinfo") as f:
@@ -620,7 +647,7 @@ _PROBES = (
     _probe_sigtrace, _probe_container_runtime, _probe_capture_dir,
     _probe_history_dir, _probe_history_tiers, _probe_standing_queries,
     _probe_fleet_health, _probe_shared_runs, _probe_device_topology,
-    _probe_pipeline_health, _probe_accuracy,
+    _probe_pipeline_health, _probe_accuracy, _probe_fleet_topology,
 )
 
 

@@ -1,0 +1,49 @@
+"""Fleet aggregation tier: aggregation as topology, not a client loop.
+
+Up to PR 16, "fleet-wide" meant the querying client pulled one merged
+summary per node over gRPC and folded them in Python — O(N) frames into
+one process, fine at 4 nodes and wrong at 400. The merge algebra is
+associative and commutative on every plane (CMS/entropy/DDSketch/
+invertible lanes add, HLL registers max, top-k candidates union-and-sum
+— history/window.py), so the fold can move onto the topology itself:
+
+- `topology.py` — the tree spec (node → zone → fleet, declared via a
+  compact grammar or auto-balanced to O(log N) fan-in) with loud typed
+  validation: every agent exactly once, no empty zones, no id reuse.
+- `aggregator.py` — the `AggregatorNode` role plus `fold_tree`: each
+  aggregator folds its children's summary windows through the SAME
+  merge algebra (`merge_windows` → `merged_to_sealed`, identical
+  total-coverage refusal rules for the qt/inv/accuracy planes) and
+  republishes ONE sealed window upward; the client queries the root.
+  `flat_summary`/`canonical_order` pin the byte-identity anchor: any
+  fold shape over the same leaf windows seals the same bytes.
+- `collective.py` — the DCN path for chip-bearing hosts in one
+  multihost slice: per-host lanes harvest over ICI, then one
+  psum/pmax crossing DCN per slice (parallel/cluster.cluster_merge
+  under a `make_multihost_mesh` mesh).
+- `sim.py` — the in-process ~100-agent chaos/scale harness (churn,
+  partition, skew) the scale proof and `perf/fleet_bench.py` drive.
+"""
+
+from .aggregator import (
+    AggregatorNode,
+    TreeFold,
+    canonical_order,
+    flat_summary,
+    fold_tree,
+)
+from .collective import fleet_collective_merge, make_fleet_merge
+from .topology import (
+    Topology,
+    TopologyError,
+    TreeNode,
+    auto_topology,
+    parse_topology,
+)
+
+__all__ = [
+    "AggregatorNode", "Topology", "TopologyError", "TreeFold", "TreeNode",
+    "auto_topology", "canonical_order", "flat_summary",
+    "fleet_collective_merge", "fold_tree", "make_fleet_merge",
+    "parse_topology",
+]

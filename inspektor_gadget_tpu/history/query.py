@@ -106,6 +106,13 @@ class QueryAnswer:
     # when ANY consulted window overflowed its top-k candidate ring.
     accuracy: dict | None = None
     approx: bool = False
+    # fleet aggregation tier (ISSUE 20): present only when the query was
+    # routed through a merge tree — {depth, fan_in, subtree_folds,
+    # fallback: [aggregator ids answered flat], aggregate: the root
+    # FleetAggregate accounting header}. The answer numbers themselves
+    # are byte-identical to the flat fold's (that is the tier's
+    # contract); this block records HOW the tree answered.
+    fleet: dict | None = None
 
     def compacted_windows(self) -> int:
         """How many folded windows were coarser than native resolution."""
@@ -141,6 +148,7 @@ class QueryAnswer:
             "paths": dict(self.paths),
             "accuracy": self.accuracy,
             "approx": self.approx,
+            "fleet": self.fleet,
         }
 
 

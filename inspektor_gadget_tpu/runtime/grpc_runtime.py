@@ -294,7 +294,7 @@ class GrpcRuntime(Runtime):
         return self._fanout_unary(pull)
 
     def query_history(self, *, key: str | None = None, top: int = 20,
-                      pushdown: bool = True, **kw) -> "Any":
+                      pushdown: bool = True, topology=None, **kw) -> "Any":
         """The fleet-wide range query. Preferred path: QueryWindows
         PUSHDOWN — every agent folds the query node-side and ships ONE
         merged window, so wire cost is O(nodes) instead of O(windows).
@@ -302,11 +302,24 @@ class GrpcRuntime(Runtime):
         to the PR-6 list+fetch pull, and the answer records which path
         each node took (`answer.paths`). Per-node errors are recorded
         in the answer, never fatal: a crashed node's peers still answer
-        for their share."""
+        for their share.
+
+        With `topology` (a fleet.Topology or a spec string — "auto",
+        "auto:<fan_in>", or the declared zone grammar), the fold routes
+        through the aggregation tier instead of one flat client loop:
+        per-node summaries fold zone-by-zone up the merge tree
+        (fleet.fold_tree), byte-identical to the flat fold by the merge
+        algebra's associativity, with per-leaf path accounting and a
+        flat re-fold of any subtree whose aggregator fails. The tree's
+        shape accounting lands in `answer.fleet`."""
         import grpc as _grpc
 
         from ..history import (answer_query, decode_frames,
                                dedupe_compacted, level_counts)
+
+        if topology is not None:
+            return self._query_history_tree(
+                topology, key=key, top=top, pushdown=pushdown, **kw)
         windows = []
         dropped: list[str] = []
         errors: dict[str, str] = {}
@@ -363,9 +376,72 @@ class GrpcRuntime(Runtime):
                 add_losses(node, losses)
             except Exception as e:  # noqa: BLE001 — per-node isolation
                 errors[node] = str(e)
-        return answer_query(windows, key=key, top=top, dropped=dropped,
-                            errors=errors, levels=levels_total,
-                            paths=paths)
+        # determinism pin: fold in canonical window order, not reply
+        # arrival order — the merge's label-map update is last-wins and
+        # its geometry base is first-wins, so an unsorted fold would let
+        # scheduling leak into the summary bytes (and break the tree
+        # tier's byte-identity anchor)
+        from ..fleet import canonical_order
+        return answer_query(canonical_order(windows), key=key, top=top,
+                            dropped=dropped, errors=errors,
+                            levels=levels_total, paths=paths)
+
+    def _query_history_tree(self, topology, *, key: str | None, top: int,
+                            pushdown: bool, **kw) -> "Any":
+        """query_history routed through the fleet aggregation tier."""
+        import grpc as _grpc
+
+        from ..fleet import flat_summary, fold_tree, parse_topology
+        from ..fleet.topology import Topology
+        from ..history import (answer_query, decode_frames,
+                               dedupe_compacted, level_counts)
+        if not isinstance(topology, Topology):
+            topology = parse_topology(str(topology), self.targets)
+        gadget = kw.get("gadget") or "fleet"
+
+        def fetch_leaf(node: str) -> dict:
+            """One leaf's share, reduced to the pushdown reply shape
+            (ONE merged window + accounting). Pre-pushdown agents fall
+            back to list+fetch and fold client-side to the same shape;
+            unreachable agents raise (fold_tree isolates them)."""
+            client = self._client(node)
+            if pushdown:
+                try:
+                    return client.query_windows(key=key, **kw)
+                except _grpc.RpcError as e:
+                    if e.code() != _grpc.StatusCode.UNIMPLEMENTED:
+                        raise RuntimeError(
+                            f"{e.code().name}: {e.details()}") from e
+                    # pre-pushdown agent: fall through to list+fetch
+            listing = client.list_windows(key=key, **kw)
+            if listing.get("windows"):
+                frames, losses = client.fetch_windows(key=key, **kw)
+            else:
+                frames, losses = [], listing.get("losses") or []
+            kept, notes = dedupe_compacted(decode_frames(frames))
+            return {"node": node,
+                    "window": flat_summary(kept, gadget=gadget, node=node),
+                    "folded": True, "levels": level_counts(kept),
+                    "torn": 0, "dropped": notes, "losses": losses}
+
+        tf = fold_tree(topology, fetch_leaf, gadget=gadget)
+        ans = answer_query(
+            [tf.window] if tf.window is not None else [],
+            key=key, top=top, dropped=tf.dropped, errors=tf.errors,
+            levels=tf.levels, paths=tf.paths)
+        # the root window answers as node "fleet"; report the leaves
+        # that actually contributed, like the flat fold does
+        ans.nodes = [n for n in sorted(tf.paths)
+                     if tf.paths.get(n) != "unreachable"]
+        ans.fleet = {
+            "depth": tf.depth,
+            "fan_in": topology.fan_in(),
+            "aggregators": len(topology.aggregators()),
+            "subtree_folds": tf.subtree_folds,
+            "fallback": list(tf.fallback),
+            "aggregate": tf.aggregate,
+        }
+        return ans
 
     # -- shared-run plane (subscribe-aware fan-out) --------------------------
 

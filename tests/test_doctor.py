@@ -34,7 +34,7 @@ def test_probe_windows_names_and_shape():
                 "container_runtime", "capture_dir", "history_dir",
                 "history_tiers", "standing_queries", "fleet_health",
                 "shared_runs", "device_topology", "pipeline_health",
-                "accuracy"}
+                "accuracy", "fleet_topology"}
     assert set(windows) == expected
     for w in windows.values():
         assert isinstance(w.ok, bool) and w.detail
@@ -63,6 +63,30 @@ def test_device_topology_row_agrees_with_probe():
         assert "shard-ingest eligible" in w.detail
     else:
         assert "needs >= 2 devices" in w.detail
+
+
+def test_fleet_topology_row_reports_tree_shape(monkeypatch, tmp_path):
+    """The fleet-tier doctor row (ISSUE 20): with no deployed fleet the
+    row passes and says the tier is a query-time choice; with a deploy
+    state it reports the auto-balanced tree's shape and wire cost."""
+    import json
+
+    from inspektor_gadget_tpu.cli import deploy
+    from inspektor_gadget_tpu.doctor import _probe_fleet_topology
+
+    state = tmp_path / "fleet.json"
+    monkeypatch.setattr(deploy, "STATE_FILE", str(state))
+    w = _probe_fleet_topology()
+    assert w.ok and "query-time choice" in w.detail
+
+    state.write_text(json.dumps(
+        {"targets": {f"n{i}": f"unix:///tmp/{i}.sock"
+                     for i in range(6)}}))
+    w = _probe_fleet_topology()
+    assert w.ok
+    assert "6 agent(s)" in w.detail
+    assert "fan-in 4" in w.detail
+    assert "frame(s)/query" in w.detail
 
 
 def test_history_dir_row_reports_writability_usage_and_free(monkeypatch,

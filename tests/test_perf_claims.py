@@ -132,6 +132,55 @@ def test_extract_claims_shapes():
     assert by_text["130.5M ev/s"].lo == 130.5e6
 
 
+def _fleet_ledger(tmp_path):
+    from inspektor_gadget_tpu.perf import append_record, make_record
+    ledger_dir = tmp_path / "benchmarks" / "ledger"
+    ledger_dir.mkdir(parents=True)
+    rec = make_record(
+        config="fleet-merge-tree", metric="query_agents100",
+        unit="queries/s", value=30.0,
+        stages={"tree_fold": {"seconds": 0.03, "events": 100.0}},
+        provenance={"git_sha": "abc", "git_dirty": False,
+                    "host": {"hostname": "h", "machine": "m",
+                             "python": "3"},
+                    "platform": "cpu", "degraded": False,
+                    "probe": {"outcome": "ok", "attempts": []}},
+        extra={"wire_windows": 134, "client_link_windows": 2})
+    append_record(rec, str(ledger_dir / "PERF.jsonl"))
+
+
+def test_wire_window_claims_backed_by_fleet_ledger(tmp_path):
+    # ISSUE 20: "N window-frame(s)" counts are structural facts matched
+    # exactly against extra.wire_windows / client_link_windows — a CPU
+    # record backs them without the degraded label (topology, not speed)
+    _fleet_ledger(tmp_path)
+    root = _repo_with(tmp_path,
+                      "the client link folds 2 window-frames; the tree "
+                      "moves 134 window-frames total\n")
+    violations, checked, _ = check_repo(root)
+    assert violations == [] and checked == 2
+    root = _repo_with(tmp_path, "the tree moves 133 window-frames\n")
+    violations, _, _ = check_repo(root)
+    assert len(violations) == 1 and "NO ledger" in violations[0]
+
+
+def test_observability_doc_scanned_for_wire_claims_only(tmp_path):
+    # docs/observability.md quotes the fictional round-5 "77.9M ev/s"
+    # in prose, so it joins the scan for wire counts ONLY
+    _fleet_ledger(tmp_path)
+    _repo_with(tmp_path, "no claims here\n")
+    (tmp_path / "docs" / "observability.md").write_text(
+        'the incident: "77.9M ev/s, real TPU"\n'
+        "the fleet root folds 7 window-frames\n")
+    violations, _, _ = check_repo(tmp_path)
+    assert len(violations) == 1
+    assert "window-frame" in violations[0]  # ev/s prose NOT flagged
+    (tmp_path / "docs" / "observability.md").write_text(
+        "the fleet root folds 2 window-frames\n")
+    violations, _, _ = check_repo(tmp_path)
+    assert violations == []
+
+
 def test_check_claim_nearest_hint(tmp_path):
     root = _repo_with(tmp_path, "x\n", TPU_BENCH)
     backings = collect_backings(root)

@@ -906,3 +906,76 @@ def test_accuracy_gauges_return_to_baseline_across_churn():
     assert not any(s.run_id == "run-acc-tm-1" for s in live_stats())
     # the feed counter is monotonic: unregister must not rewind it
     assert _default_metric("ig_sketch_audit_samples_total") == fed0 + 400
+
+
+def test_fleet_merge_metrics_lifecycle(monkeypatch):
+    """Fleet aggregation tier (ISSUE 20) metric discipline: the depth
+    gauge holds the tree's height exactly while a fold is in flight and
+    sits back at 0 after (crash paths included — it resets in a
+    finally), subtree folds count per aggregator with a result label,
+    and the fallback counter trips once per subtree re-folded flat."""
+    from inspektor_gadget_tpu.fleet import aggregator as agg_mod
+    from inspektor_gadget_tpu.fleet import fold_tree
+    from inspektor_gadget_tpu.fleet.sim import GADGET, SimFleet
+
+    assert _default_metric("ig_fleet_merge_depth") == 0.0
+    ok0 = _default_metric("ig_fleet_subtree_folds_total", result="ok")
+    failed0 = _default_metric("ig_fleet_subtree_folds_total",
+                              result="failed")
+    fb0 = _default_metric("ig_fleet_fallback_total")
+
+    fleet = SimFleet(8, n_windows=1)
+    topo = fleet.topology("auto:4")
+    in_flight: list[float] = []
+
+    def spying_fetch(node):
+        in_flight.append(_default_metric("ig_fleet_merge_depth"))
+        return fleet.fetch_leaf(node)
+
+    tf = fold_tree(topo, spying_fetch, gadget=GADGET)
+    assert tf.window is not None
+    # set for the WHOLE fold (every leaf pull saw it), 0 again after
+    assert in_flight and all(v == float(topo.depth())
+                             for v in in_flight)
+    assert _default_metric("ig_fleet_merge_depth") == 0.0
+    assert _default_metric("ig_fleet_subtree_folds_total",
+                           result="ok") == ok0 + len(topo.aggregators())
+    assert _default_metric("ig_fleet_subtree_folds_total",
+                           result="failed") == failed0
+    assert _default_metric("ig_fleet_fallback_total") == fb0
+
+    # client-driven aggregator crash: failed + fallback each tick once,
+    # the refold still answers, the gauge still lands back at 0
+    real = agg_mod.merged_to_sealed
+    crashed: list[str] = []
+
+    def crash_once(merged, *, gadget, node):
+        if node == "agg1-000" and not crashed:
+            crashed.append(node)
+            raise RuntimeError("injected seal crash")
+        return real(merged, gadget=gadget, node=node)
+
+    monkeypatch.setattr(agg_mod, "merged_to_sealed", crash_once)
+    tf2 = fold_tree(topo, fleet.fetch_leaf, gadget=GADGET)
+    monkeypatch.setattr(agg_mod, "merged_to_sealed", real)
+    assert tf2.fallback == ["agg1-000"] and tf2.window is not None
+    assert _default_metric("ig_fleet_subtree_folds_total",
+                           result="failed") == failed0 + 1
+    assert _default_metric("ig_fleet_fallback_total") == fb0 + 1
+    assert _default_metric("ig_fleet_merge_depth") == 0.0
+
+    # unreachable deployed aggregator: fallback ticks, failed does not
+    # (nothing crashed HERE — the remote tier just never answered)
+    fetch_subtree = fleet.make_fetch_subtree(fail={"fleet"})
+    tf3 = fold_tree(topo, fleet.fetch_leaf,
+                    fetch_subtree=fetch_subtree, gadget=GADGET)
+    assert tf3.fallback == ["fleet"] and tf3.window is not None
+    assert _default_metric("ig_fleet_fallback_total") == fb0 + 2
+    assert _default_metric("ig_fleet_subtree_folds_total",
+                           result="failed") == failed0 + 1
+    assert _default_metric("ig_fleet_merge_depth") == 0.0
+
+    text = telemetry.render_prometheus()
+    assert "ig_fleet_merge_depth" in text
+    assert "ig_fleet_subtree_folds_total" in text
+    assert "ig_fleet_fallback_total" in text

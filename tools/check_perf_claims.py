@@ -29,6 +29,15 @@ ceiling. These are bound-style claims (artifact ≤ ceiling, not a ±tol
 band) and are exempt from the cpu/degraded rule — the sketch's error is
 arithmetic, not machine speed.
 
+Fleet wire-cost claims (ISSUE 20) too: "N window-frame(s)" prose about
+the aggregation tier's fan-in economics must exactly match a fleet
+ledger record's `extra.wire_windows` or `extra.client_link_windows`.
+These are structural counts (tree edges, root fan-in) — exact-match,
+and exempt from the cpu/degraded rule for the same reason as err_pct.
+docs/observability.md is scanned for THIS kind only: its prose
+narrates the round-5 incident's fictional "77.9M ev/s" in quotes,
+which the throughput scanner would flag as an unbacked claim.
+
 Run standalone (``python tools/check_perf_claims.py [repo_root]``, exit
 1 on violations) or through tier-1 (tests/test_perf_claims.py).
 """
@@ -42,6 +51,8 @@ import re
 import sys
 
 DOC_FILES = ("docs/performance.md", "BASELINE.md", "README.md")
+# scanned ONLY for wire_windows claims — see the module docstring
+WIRE_ONLY_FILES = ("docs/observability.md",)
 # code files whose docstrings make accuracy promises — the "well under
 # the 1%" prose is a claim like any other and gets the same no-drift rule
 CODE_FILES = ("inspektor_gadget_tpu/ops/countmin.py",)
@@ -86,6 +97,15 @@ STARVED_RE = re.compile(
 ERR_RE = re.compile(
     r"error\s+(?:stays\s+)?(?:well\s+)?(?:under|below|within)\s+"
     r"(?:the\s+)?(?P<num>\d+(?:\.\d+)?)\s*%",
+    re.IGNORECASE | re.UNICODE)
+
+# fleet wire-cost claims (ISSUE 20): "134 window-frames", "2
+# window-frame(s)" — structural counts of merged-summary frames on a
+# link, matched EXACTLY against a fleet ledger record's
+# extra.wire_windows / extra.client_link_windows
+WIRE_RE = re.compile(
+    r"(?P<prefix>[~≥≤<>=]\s*)?"
+    r"(?P<num>\d+)\s*window-frames?\b",
     re.IGNORECASE | re.UNICODE)
 
 
@@ -158,6 +178,14 @@ def extract_claims(text: str, path: str) -> list[Claim]:
                       line=line, lo=0.0, hi=ceiling, approx=False,
                       kind="err_pct"),
                 "", lower))
+        for m in WIRE_RE.finditer(line):
+            prefix = (m.group("prefix") or "").strip()
+            n = float(m.group("num"))
+            out.append(_classify(
+                Claim(path=path, lineno=lineno, text=m.group(0),
+                      line=line, lo=n, hi=n, approx=False,
+                      kind="wire_windows"),
+                prefix, lower))
     return out
 
 
@@ -215,6 +243,11 @@ def _ledger_backings(path: pathlib.Path) -> list[Backing]:
             out.append(Backing(float(oe), platform, degraded,
                                f"{src}#observed_err_pct",
                                kind="err_pct"))
+        for wk in ("wire_windows", "client_link_windows"):
+            wv = (rec.get("extra") or {}).get(wk)
+            if isinstance(wv, (int, float)):
+                out.append(Backing(float(wv), platform, degraded,
+                                   f"{src}#{wk}", kind="wire_windows"))
     return out
 
 
@@ -237,6 +270,10 @@ def _matches(claim: Claim, b: Backing) -> bool:
         # bound-style: the artifact must sit at or inside the claimed
         # ceiling — an observed error above it falsifies the prose
         return 0.0 <= b.value <= claim.hi
+    if claim.kind == "wire_windows":
+        # structural counts (tree edges + 1, root fan-in): a frame
+        # count is an integer fact, not a measurement — exact match
+        return b.value == claim.lo
     tol = TOL_APPROX if claim.approx else TOL
     return claim.lo * (1 - tol) <= b.value <= claim.hi * (1 + tol)
 
@@ -255,8 +292,10 @@ def check_claim(claim: Claim, backings: list[Backing]) -> str:
         return (f"{claim.path}:{claim.lineno}: claim '{claim.text.strip()}' "
                 f"is backed by NO ledger/BENCH artifact{hint} — record it, "
                 f"fix it, or label it 'unrecorded'")
-    if all(b.second_class for b in hits) and claim.kind != "err_pct":
-        # err_pct is exempt: sketch error is arithmetic, the same on any
+    if (all(b.second_class for b in hits)
+            and claim.kind not in ("err_pct", "wire_windows")):
+        # err_pct / wire_windows are exempt: sketch error is arithmetic
+        # and frame counts are topology facts, the same on any
         # platform — a CPU-audited bound is as real as a TPU one
         lower = claim.line.lower()
         if "cpu" not in lower and "degraded" not in lower:
@@ -275,11 +314,13 @@ def check_repo(root: str | pathlib.Path) -> tuple[list[str], int, int]:
     backings = collect_backings(root)
     violations: list[str] = []
     checked = waived = 0
-    for rel in DOC_FILES + CODE_FILES:
+    for rel in DOC_FILES + CODE_FILES + WIRE_ONLY_FILES:
         p = root / rel
         if not p.exists():
             continue
         for claim in extract_claims(p.read_text(encoding="utf-8"), rel):
+            if rel in WIRE_ONLY_FILES and claim.kind != "wire_windows":
+                continue
             if claim.skipped:
                 if claim.skipped.startswith("explicitly"):
                     waived += 1
